@@ -1,0 +1,147 @@
+"""Flat (device) representation of expression-tree populations.
+
+The TPU never sees pointer trees. A batch of trees is a struct-of-arrays of
+padded postorder tensors — the design called for by SURVEY.md §7.1 and the
+driver north star: host<->device traffic is only these tensors plus loss
+vectors. Replaces the role of DynamicExpressions.jl's recursive ``Node``
+storage for everything math-related.
+
+Postorder invariant: children of slot ``i`` are at slots ``< i``; the root of
+tree ``p`` is at slot ``length[p] - 1``. Padding slots have kind=PAD and write
+zeros during evaluation; they are never read by live slots.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from ..tree import Node
+
+__all__ = [
+    "KIND_PAD",
+    "KIND_CONST",
+    "KIND_VAR",
+    "KIND_UNARY",
+    "KIND_BINARY",
+    "FlatTrees",
+    "flatten_trees",
+    "unflatten_tree",
+    "pad_bucket",
+]
+
+KIND_PAD = 0
+KIND_CONST = 1
+KIND_VAR = 2
+KIND_UNARY = 3
+KIND_BINARY = 4
+
+
+class FlatTrees(NamedTuple):
+    """A padded batch of postorder trees. All arrays share leading dim P.
+
+    kind:   int32[P, N]  node kind (see KIND_*)
+    op:     int32[P, N]  operator index within its arity table
+    lhs:    int32[P, N]  left-child slot (< slot index); 0 for leaves
+    rhs:    int32[P, N]  right-child slot; 0 for non-binary
+    feat:   int32[P, N]  feature index for KIND_VAR slots
+    val:    float[P, N]  constant value for KIND_CONST slots (the only
+                         differentiable leaf array — `jax.grad` targets this)
+    length: int32[P]     number of live slots; root at length-1
+    """
+
+    kind: np.ndarray
+    op: np.ndarray
+    lhs: np.ndarray
+    rhs: np.ndarray
+    feat: np.ndarray
+    val: np.ndarray
+    length: np.ndarray
+
+    @property
+    def n_trees(self) -> int:
+        return self.kind.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.kind.shape[1]
+
+
+def pad_bucket(n: int, multiple: int = 8) -> int:
+    """Round a node budget up to a padding bucket so XLA compiles O(1)
+    programs across the whole search (SURVEY.md §7.3 recompilation risk)."""
+    return max(multiple, ((n + multiple - 1) // multiple) * multiple)
+
+
+def flatten_trees(
+    trees: list[Node], max_nodes: int, dtype=np.float32
+) -> FlatTrees:
+    """Flatten host trees into one padded postorder batch (numpy; the caller
+    device_puts / donates). Trees longer than max_nodes are a bug upstream —
+    constraint checking caps sizes before anything is flattened."""
+    P = len(trees)
+    kind = np.zeros((P, max_nodes), dtype=np.int32)
+    op = np.zeros((P, max_nodes), dtype=np.int32)
+    lhs = np.zeros((P, max_nodes), dtype=np.int32)
+    rhs = np.zeros((P, max_nodes), dtype=np.int32)
+    feat = np.zeros((P, max_nodes), dtype=np.int32)
+    val = np.zeros((P, max_nodes), dtype=dtype)
+    length = np.zeros((P,), dtype=np.int32)
+
+    for p, tree in enumerate(trees):
+        post = tree.postorder()
+        if len(post) > max_nodes:
+            raise ValueError(
+                f"tree {p} has {len(post)} nodes > max_nodes={max_nodes}"
+            )
+        slot_of = {}
+        for i, n in enumerate(post):
+            slot_of[id(n)] = i
+            if n.degree == 0:
+                if n.is_const:
+                    kind[p, i] = KIND_CONST
+                    val[p, i] = n.val
+                else:
+                    kind[p, i] = KIND_VAR
+                    feat[p, i] = n.feat
+            elif n.degree == 1:
+                kind[p, i] = KIND_UNARY
+                op[p, i] = n.op
+                lhs[p, i] = slot_of[id(n.l)]
+            else:
+                kind[p, i] = KIND_BINARY
+                op[p, i] = n.op
+                lhs[p, i] = slot_of[id(n.l)]
+                rhs[p, i] = slot_of[id(n.r)]
+        length[p] = len(post)
+
+    return FlatTrees(kind, op, lhs, rhs, feat, val, length)
+
+
+def unflatten_tree(flat: FlatTrees, p: int) -> Node:
+    """Rebuild a host tree from batch row p (round-trip of flatten_trees)."""
+    kind = np.asarray(flat.kind[p])
+    op_arr = np.asarray(flat.op[p])
+    lhs = np.asarray(flat.lhs[p])
+    rhs = np.asarray(flat.rhs[p])
+    feat = np.asarray(flat.feat[p])
+    val = np.asarray(flat.val[p])
+    n = int(np.asarray(flat.length[p]))
+
+    nodes: list[Node] = []
+    for i in range(n):
+        k = int(kind[i])
+        if k == KIND_CONST:
+            nodes.append(Node(0, is_const=True, val=float(val[i])))
+        elif k == KIND_VAR:
+            nodes.append(Node(0, is_const=False, feat=int(feat[i])))
+        elif k == KIND_UNARY:
+            nodes.append(Node(1, op=int(op_arr[i]), l=nodes[int(lhs[i])]))
+        elif k == KIND_BINARY:
+            nodes.append(
+                Node(2, op=int(op_arr[i]), l=nodes[int(lhs[i])], r=nodes[int(rhs[i])])
+            )
+        else:
+            raise ValueError(f"pad slot {i} inside live range of tree {p}")
+    return nodes[-1]

@@ -1,0 +1,257 @@
+"""Batched XLA interpreter for flat expression trees — the L0 kernel.
+
+This is the TPU replacement for DynamicExpressions.jl's recursive
+``eval_tree_array`` (documented at
+/root/reference/src/InterfaceDynamicExpressions.jl:30-55): instead of
+recursing tree-at-a-time, a whole population evaluates as ONE XLA program —
+a single ``lax.scan`` over postorder slots carrying an SSA value buffer,
+``vmap``-ed over the population axis and vectorized over the dataset-row axis
+(rows live in the lane dimension of the VPU).
+
+Differentiation: ``eval_grad_tree_array``-for-constants
+(/root/reference/src/InterfaceDynamicExpressions.jl:90-124) is replaced by
+``jax.grad`` through this interpreter. A custom VJP exploits the SSA
+structure: every slot is written exactly once, so the final forward buffer IS
+the complete tape, and the backward pass is one reverse scan propagating
+adjoints to children — O(N·R) memory instead of the O(N²·R) a naive
+scan-transpose would need.
+
+NaN semantics: invalid math yields NaN/Inf at the root (safe operators,
+ops/operators.py); ``ok = isfinite(pred).all(rows)`` reproduces the
+reference's ``completed`` flag used for Inf-loss rejection
+(/root/reference/src/LossFunctions.jl:55-57).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .flat import KIND_BINARY, KIND_CONST, KIND_UNARY, KIND_VAR, FlatTrees
+from .operators import OperatorSet
+
+__all__ = ["eval_trees", "eval_trees_with_ok"]
+
+
+class _Structure(NamedTuple):
+    """Non-differentiable portion of FlatTrees for one tree."""
+
+    kind: jax.Array  # int32[N]
+    op: jax.Array  # int32[N]
+    lhs: jax.Array  # int32[N]
+    rhs: jax.Array  # int32[N]
+    feat: jax.Array  # int32[N]
+    length: jax.Array  # int32[]
+
+
+def _apply_unary(opset: OperatorSet, o, x):
+    if opset.n_unary == 0:
+        return x
+    if opset.n_unary == 1:
+        return opset.unary[0].fn(x)
+    return lax.switch(jnp.clip(o, 0, opset.n_unary - 1), [op.fn for op in opset.unary], x)
+
+
+def _apply_binary(opset: OperatorSet, o, l, r):
+    if opset.n_binary == 0:
+        return l
+    if opset.n_binary == 1:
+        return opset.binary[0].fn(l, r)
+    return lax.switch(
+        jnp.clip(o, 0, opset.n_binary - 1),
+        [op.fn for op in opset.binary],
+        l,
+        r,
+    )
+
+
+def _unary_pullback(opset: OperatorSet, o, x, ct):
+    """d(op(x))/dx contracted with cotangent ct, dispatched on op index."""
+    if opset.n_unary == 0:
+        return jnp.zeros_like(x)
+
+    def mk(fn):
+        def branch(operands):
+            x_, ct_ = operands
+            _, pull = jax.vjp(fn, x_)
+            return pull(ct_)[0]
+
+        return branch
+
+    if opset.n_unary == 1:
+        return mk(opset.unary[0].fn)((x, ct))
+    return lax.switch(
+        jnp.clip(o, 0, opset.n_unary - 1),
+        [mk(op.fn) for op in opset.unary],
+        (x, ct),
+    )
+
+
+def _binary_pullback(opset: OperatorSet, o, l, r, ct):
+    if opset.n_binary == 0:
+        return jnp.zeros_like(l), jnp.zeros_like(r)
+
+    def mk(fn):
+        def branch(operands):
+            l_, r_, ct_ = operands
+            _, pull = jax.vjp(fn, l_, r_)
+            return pull(ct_)
+
+        return branch
+
+    if opset.n_binary == 1:
+        return mk(opset.binary[0].fn)((l, r, ct))
+    return lax.switch(
+        jnp.clip(o, 0, opset.n_binary - 1),
+        [mk(op.fn) for op in opset.binary],
+        (l, r, ct),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _eval_one(opset: OperatorSet, structure: _Structure, val: jax.Array, X: jax.Array):
+    """Evaluate one tree on all rows. val: float[N]; X: float[F, R] -> [R]."""
+    pred, _ = _forward(opset, structure, val, X)
+    return pred
+
+
+def _forward(opset, structure: _Structure, val, X):
+    N = structure.kind.shape[0]
+    R = X.shape[1]
+    dtype = X.dtype
+    buf0 = jnp.zeros((N, R), dtype)
+    zeros_row = jnp.zeros((R,), dtype)
+
+    def step(buf, slot):
+        i, k, o, li, ri, fi, v = slot
+        l = lax.dynamic_index_in_dim(buf, li, 0, keepdims=False)
+        r = lax.dynamic_index_in_dim(buf, ri, 0, keepdims=False)
+        xvar = lax.dynamic_index_in_dim(X, fi, 0, keepdims=False)
+        un = _apply_unary(opset, o, l)
+        bi = _apply_binary(opset, o, l, r)
+        res = lax.select_n(
+            k,
+            zeros_row,
+            jnp.full((R,), v, dtype),
+            xvar.astype(dtype),
+            un.astype(dtype),
+            bi.astype(dtype),
+        )
+        buf = lax.dynamic_update_index_in_dim(buf, res, i, 0)
+        return buf, None
+
+    slots = (
+        jnp.arange(N, dtype=jnp.int32),
+        structure.kind,
+        structure.op,
+        structure.lhs,
+        structure.rhs,
+        structure.feat,
+        val.astype(dtype),
+    )
+    buf, _ = lax.scan(step, buf0, slots)
+    pred = lax.dynamic_index_in_dim(buf, structure.length - 1, 0, keepdims=False)
+    return pred, buf
+
+
+def _eval_one_fwd(opset, structure, val, X):
+    pred, buf = _forward(opset, structure, val, X)
+    return pred, (structure, val, X, buf)
+
+
+def _eval_one_bwd(opset, residuals, g_pred):
+    structure, val, X, buf = residuals
+    N = structure.kind.shape[0]
+    dtype = buf.dtype
+
+    gbuf0 = jnp.zeros_like(buf)
+    gbuf0 = lax.dynamic_update_index_in_dim(
+        gbuf0, g_pred.astype(dtype), structure.length - 1, 0
+    )
+    gX0 = jnp.zeros_like(X)
+    gval0 = jnp.zeros_like(val)
+
+    def step(carry, slot):
+        gbuf, gX, gval = carry
+        i, k, o, li, ri, fi = slot
+        a = lax.dynamic_index_in_dim(gbuf, i, 0, keepdims=False)
+        l = lax.dynamic_index_in_dim(buf, li, 0, keepdims=False)
+        r = lax.dynamic_index_in_dim(buf, ri, 0, keepdims=False)
+
+        is_un = k == KIND_UNARY
+        is_bi = k == KIND_BINARY
+        dl_un = _unary_pullback(opset, o, l, a)
+        dl_bi, dr_bi = _binary_pullback(opset, o, l, r, a)
+        dl = jnp.where(is_un, dl_un, 0.0) + jnp.where(is_bi, dl_bi, 0.0)
+        dr = jnp.where(is_bi, dr_bi, 0.0)
+
+        # Children are at strictly smaller slots, so adding into them before
+        # they are visited (we iterate i descending) is safe; slot i itself is
+        # finalized once visited.
+        li_safe = jnp.where(is_un | is_bi, li, i)
+        ri_safe = jnp.where(is_bi, ri, i)
+        dl = jnp.where(is_un | is_bi, dl, 0.0)
+        dr = jnp.where(is_bi, dr, 0.0)
+        gbuf = gbuf.at[li_safe].add(dl)
+        gbuf = gbuf.at[ri_safe].add(dr)
+
+        gX = gX.at[fi].add(jnp.where(k == KIND_VAR, a, 0.0).astype(X.dtype))
+        gval = gval.at[i].set(
+            jnp.where(k == KIND_CONST, a.sum(), 0.0).astype(val.dtype)
+        )
+        return (gbuf, gX, gval), None
+
+    slots = (
+        jnp.arange(N, dtype=jnp.int32),
+        structure.kind,
+        structure.op,
+        structure.lhs,
+        structure.rhs,
+        structure.feat,
+    )
+    (gbuf, gX, gval), _ = lax.scan(step, (gbuf0, gX0, gval0), slots, reverse=True)
+
+    g_structure = _Structure(
+        kind=_float0(structure.kind),
+        op=_float0(structure.op),
+        lhs=_float0(structure.lhs),
+        rhs=_float0(structure.rhs),
+        feat=_float0(structure.feat),
+        length=_float0(structure.length),
+    )
+    return (g_structure, gval, gX)
+
+
+def _float0(x):
+    return np.zeros(np.shape(x), dtype=jax.dtypes.float0)
+
+
+_eval_one.defvjp(_eval_one_fwd, _eval_one_bwd)
+
+
+def eval_trees(flat: FlatTrees, X: jax.Array, opset: OperatorSet) -> jax.Array:
+    """Evaluate a batch of trees: FlatTrees[P,N] x X[F,R] -> preds[P,R]."""
+    # Normalize to device arrays: raw numpy leaves inside custom_vjp residuals
+    # break JAX's batching rules (and would re-upload per call anyway).
+    flat = FlatTrees(*(jnp.asarray(a) for a in flat))
+    X = jnp.asarray(X)
+    structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
+    fn = jax.vmap(
+        functools.partial(_eval_one, opset),
+        in_axes=(_Structure(0, 0, 0, 0, 0, 0), 0, None),
+    )
+    return fn(structure, flat.val, X)
+
+
+def eval_trees_with_ok(
+    flat: FlatTrees, X: jax.Array, opset: OperatorSet
+) -> tuple[jax.Array, jax.Array]:
+    """As eval_trees, plus the per-tree `completed` flag: all rows finite."""
+    preds = eval_trees(flat, X, opset)
+    ok = jnp.isfinite(preds).all(axis=-1)
+    return preds, ok

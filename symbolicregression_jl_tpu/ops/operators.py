@@ -1,0 +1,415 @@
+"""Operator library with NaN-guarded "safe" semantics.
+
+TPU-native re-design of the reference operator library
+(/root/reference/src/Operators.jl:11-100): invalid math returns ``NaN`` so that
+evaluation always completes and the finiteness check at the root decides
+validity (the reference documents this mechanism at
+/root/reference/src/InterfaceDynamicExpressions.jl:30-55).
+
+Every operator is a pure elementwise JAX function, written with the
+"double-where" pattern so that `jax.grad` through an invalid region yields a
+clean NaN only where the *value* is NaN (no spurious NaN pollution of valid
+lanes), which matters because constant optimization differentiates through the
+batched evaluator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Operator",
+    "OperatorSet",
+    "UNARY_OPS",
+    "BINARY_OPS",
+    "resolve_operators",
+    "default_operator_set",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Operator:
+    """A primitive operator usable inside expression trees.
+
+    Attributes:
+      name: canonical name (used in serialization and printing).
+      arity: 1 or 2.
+      fn: the JAX implementation (elementwise, NaN-guarded).
+      display: infix symbol for binary operators (None -> function-call form).
+    """
+
+    name: str
+    arity: int
+    fn: Callable[..., jax.Array]
+    display: str | None = None
+
+    def __call__(self, *args):
+        return self.fn(*args)
+
+    # Hash/eq include fn identity: OperatorSet is a static jit argument, and
+    # two differently-implemented operators that happen to share a name must
+    # NOT hit the same compiled-program cache entry.
+    def __hash__(self):
+        return hash((self.name, self.arity, id(self.fn)))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Operator)
+            and self.name == other.name
+            and self.arity == other.arity
+            and self.fn is other.fn
+        )
+
+
+def _nan_like(x):
+    return jnp.full_like(x, jnp.nan)
+
+
+def _guard(invalid, safe_x, compute):
+    """double-where: compute(compute-safe input) with NaN where invalid."""
+    return jnp.where(invalid, jnp.nan, compute(safe_x))
+
+
+# ---------------------------------------------------------------------------
+# Unary operators
+# ---------------------------------------------------------------------------
+
+
+def safe_log(x):
+    bad = x <= 0
+    return _guard(bad, jnp.where(bad, 1.0, x), jnp.log)
+
+
+def safe_log2(x):
+    bad = x <= 0
+    return _guard(bad, jnp.where(bad, 1.0, x), jnp.log2)
+
+
+def safe_log10(x):
+    bad = x <= 0
+    return _guard(bad, jnp.where(bad, 1.0, x), jnp.log10)
+
+
+def safe_log1p(x):
+    bad = x <= -1
+    return _guard(bad, jnp.where(bad, 0.0, x), jnp.log1p)
+
+
+def safe_sqrt(x):
+    bad = x < 0
+    return _guard(bad, jnp.where(bad, 1.0, x), jnp.sqrt)
+
+
+def safe_acosh(x):
+    bad = x < 1
+    return _guard(bad, jnp.where(bad, 1.0, x), jnp.arccosh)
+
+
+def safe_asin(x):
+    bad = jnp.abs(x) > 1
+    return _guard(bad, jnp.where(bad, 0.0, x), jnp.arcsin)
+
+
+def safe_acos(x):
+    bad = jnp.abs(x) > 1
+    return _guard(bad, jnp.where(bad, 0.0, x), jnp.arccos)
+
+
+def safe_atanh(x):
+    bad = jnp.abs(x) >= 1
+    return _guard(bad, jnp.where(bad, 0.0, x), jnp.arctanh)
+
+
+def atanh_clip(x):
+    # atanh((x + 1) % 2 - 1), matching the reference's clipped variant
+    # (/root/reference/src/Operators.jl:17).
+    wrapped = jnp.mod(x + 1.0, 2.0) - 1.0
+    return safe_atanh(wrapped)
+
+
+def gamma_full(x):
+    """Gamma with reflection for negative arguments, Inf->NaN."""
+    ax = jnp.where(x < 0, 1.0 - x, x)  # >= 1 region, lgamma-safe
+    pos = jnp.exp(jax.lax.lgamma(jnp.where(ax > 0, ax, 1.0)))
+    sin_pix = jnp.sin(jnp.pi * x)
+    refl = jnp.pi / (sin_pix * pos)
+    out = jnp.where(x < 0, refl, jnp.exp(jax.lax.lgamma(jnp.where(x > 0, x, 1.0))))
+    out = jnp.where(x == jnp.floor(x), jnp.where(x > 0, out, jnp.nan), out)
+    return jnp.where(jnp.isfinite(out), out, jnp.nan)
+
+
+def square(x):
+    return x * x
+
+
+def cube(x):
+    return x * x * x
+
+
+def neg(x):
+    return -x
+
+
+def relu(x):
+    return (x > 0) * x
+
+
+def sign_op(x):
+    return jnp.sign(x)
+
+
+# ---------------------------------------------------------------------------
+# Binary operators
+# ---------------------------------------------------------------------------
+
+
+def safe_pow(x, y):
+    """Match the reference's safe_pow (/root/reference/src/Operators.jl:28-36):
+
+    integer y:      y < 0 and x == 0       -> NaN
+    non-integer y:  y > 0 and x < 0        -> NaN
+                    y < 0 and x <= 0       -> NaN
+    otherwise x ** y (negative base allowed for integer exponents).
+    """
+    yi = jnp.round(y)
+    y_is_int = y == yi
+    invalid = jnp.where(
+        y_is_int,
+        (yi < 0) & (x == 0),
+        jnp.where(y > 0, x < 0, x <= 0),
+    )
+    ax = jnp.abs(x)
+    ax_safe = jnp.where(invalid | (ax == 0), 1.0, ax)
+    mag = jnp.where(ax == 0, jnp.where(y == 0, 1.0, 0.0), ax_safe**y)
+    odd = jnp.mod(jnp.abs(yi), 2.0) == 1.0
+    signed = jnp.where((x < 0) & odd, -mag, mag)
+    return jnp.where(invalid, jnp.nan, signed)
+
+
+def plus(x, y):
+    return x + y
+
+
+def sub(x, y):
+    return x - y
+
+
+def mult(x, y):
+    return x * y
+
+
+def div(x, y):
+    # Julia float semantics: x/0 = +-Inf, 0/0 = NaN; the finiteness check at the
+    # root rejects both. XLA matches IEEE here.
+    return x / y
+
+
+def mod_op(x, y):
+    # Julia mod(x, y) has the sign of y (true floored modulo) == jnp.mod.
+    return jnp.mod(x, y)
+
+
+def greater(x, y):
+    return (x > y) * jnp.ones_like(x)
+
+
+def cond_op(x, y):
+    return (x > 0) * y
+
+
+def logical_or(x, y):
+    return ((x > 0) | (y > 0)) * jnp.ones_like(x)
+
+
+def logical_and(x, y):
+    return ((x > 0) & (y > 0)) * jnp.ones_like(x)
+
+
+def max_op(x, y):
+    return jnp.maximum(x, y)
+
+
+def min_op(x, y):
+    return jnp.minimum(x, y)
+
+
+def _u(name, fn, display=None):
+    return Operator(name=name, arity=1, fn=fn, display=display)
+
+
+def _b(name, fn, display=None):
+    return Operator(name=name, arity=2, fn=fn, display=display)
+
+
+UNARY_OPS: dict[str, Operator] = {
+    op.name: op
+    for op in [
+        _u("neg", neg, "-"),
+        _u("square", square),
+        _u("cube", cube),
+        _u("exp", jnp.exp),
+        _u("abs", jnp.abs),
+        _u("log", safe_log),
+        _u("log2", safe_log2),
+        _u("log10", safe_log10),
+        _u("log1p", safe_log1p),
+        _u("sqrt", safe_sqrt),
+        _u("sin", jnp.sin),
+        _u("cos", jnp.cos),
+        _u("tan", jnp.tan),
+        _u("sinh", jnp.sinh),
+        _u("cosh", jnp.cosh),
+        _u("tanh", jnp.tanh),
+        _u("asin", safe_asin),
+        _u("acos", safe_acos),
+        _u("atan", jnp.arctan),
+        _u("asinh", jnp.arcsinh),
+        _u("acosh", safe_acosh),
+        _u("atanh", safe_atanh),
+        _u("atanh_clip", atanh_clip),
+        _u("erf", jax.scipy.special.erf),
+        _u("erfc", jax.scipy.special.erfc),
+        _u("gamma", gamma_full),
+        _u("relu", relu),
+        _u("round", jnp.round),
+        _u("floor", jnp.floor),
+        _u("ceil", jnp.ceil),
+        _u("sign", sign_op),
+    ]
+}
+
+BINARY_OPS: dict[str, Operator] = {
+    op.name: op
+    for op in [
+        _b("add", plus, "+"),
+        _b("sub", sub, "-"),
+        _b("mult", mult, "*"),
+        _b("div", div, "/"),
+        _b("pow", safe_pow, "^"),
+        _b("mod", mod_op),
+        _b("greater", greater),
+        _b("cond", cond_op),
+        _b("logical_or", logical_or),
+        _b("logical_and", logical_and),
+        _b("max", max_op),
+        _b("min", min_op),
+    ]
+}
+
+# Aliases matching the reference's binopmap/unaopmap un-aliasing
+# (/root/reference/src/Options.jl:92-150): users may write the plain name and
+# get the safe variant.
+_ALIASES = {
+    "+": "add",
+    "-": "sub",
+    "*": "mult",
+    "/": "div",
+    "^": "pow",
+    "safe_pow": "pow",
+    "safe_log": "log",
+    "safe_log2": "log2",
+    "safe_log10": "log10",
+    "safe_log1p": "log1p",
+    "safe_sqrt": "sqrt",
+    "safe_acosh": "acosh",
+    "safe_asin": "asin",
+    "safe_acos": "acos",
+    "safe_atanh": "atanh",
+    "plus": "add",
+    "mult": "mult",
+}
+
+
+class OperatorSet:
+    """The chosen operator vocabulary of a search (reference: OperatorEnum).
+
+    Immutable and hashable: used as a static argument to jitted kernels, so a
+    given operator set compiles exactly one XLA program per data shape.
+    """
+
+    __slots__ = ("unary", "binary", "_hash")
+
+    def __init__(self, binary: Sequence[Operator], unary: Sequence[Operator]):
+        self.binary = tuple(binary)
+        self.unary = tuple(unary)
+        self._hash = hash((self.binary, self.unary))
+        names = [op.name for op in self.binary + self.unary]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate operators in set: {names}")
+
+    def __setattr__(self, k, v):
+        if hasattr(self, "_hash"):
+            raise AttributeError("OperatorSet is immutable")
+        object.__setattr__(self, k, v)
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, OperatorSet)
+            and self.binary == other.binary
+            and self.unary == other.unary
+        )
+
+    def __repr__(self):
+        return (
+            "OperatorSet(binary=[" + ", ".join(o.name for o in self.binary) + "], "
+            "unary=[" + ", ".join(o.name for o in self.unary) + "])"
+        )
+
+    @property
+    def n_binary(self):
+        return len(self.binary)
+
+    @property
+    def n_unary(self):
+        return len(self.unary)
+
+    def binary_index(self, name: str) -> int:
+        name = _ALIASES.get(name, name)
+        for i, op in enumerate(self.binary):
+            if op.name == name:
+                return i
+        raise KeyError(name)
+
+    def unary_index(self, name: str) -> int:
+        name = _ALIASES.get(name, name)
+        for i, op in enumerate(self.unary):
+            if op.name == name:
+                return i
+        raise KeyError(name)
+
+
+def _resolve_one(spec, table: dict[str, Operator], kind: str) -> Operator:
+    if isinstance(spec, Operator):
+        return spec
+    if callable(spec):  # raw python/jax function -> wrap
+        name = getattr(spec, "__name__", None) or repr(spec)
+        name = _ALIASES.get(name, name)
+        if name in table:
+            return table[name]
+        return Operator(name=name, arity=1 if kind == "unary" else 2, fn=spec)
+    if isinstance(spec, str):
+        name = _ALIASES.get(spec, spec)
+        if name not in table:
+            raise KeyError(f"unknown {kind} operator {spec!r}; known: {sorted(table)}")
+        return table[name]
+    raise TypeError(f"cannot interpret operator spec {spec!r}")
+
+
+def resolve_operators(binary_operators, unary_operators) -> OperatorSet:
+    """Build an OperatorSet from names / callables / Operator instances."""
+    binary = [_resolve_one(s, BINARY_OPS, "binary") for s in binary_operators]
+    unary = [_resolve_one(s, UNARY_OPS, "unary") for s in unary_operators]
+    return OperatorSet(binary=binary, unary=unary)
+
+
+def default_operator_set() -> OperatorSet:
+    # Reference default: binary [+, -, /, *], no unary
+    # (/root/reference/src/Options.jl defaults).
+    return resolve_operators(["add", "sub", "div", "mult"], [])

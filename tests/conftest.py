@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+imports, so multi-chip sharding paths are exercised without TPU hardware
+(mirrors how the reference tests :multiprocessing with local workers,
+/root/reference/test/manual_distributed.jl)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override: the shell pre-sets the TPU platform
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,178 @@
+"""Golden tests for the batched XLA interpreter vs. recursive numpy eval
+(the oracle strategy the reference uses in test/test_evaluation.jl)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.ops import (
+    eval_trees,
+    eval_trees_with_ok,
+    flatten_trees,
+    resolve_operators,
+    unflatten_tree,
+)
+from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+OPS = resolve_operators(["add", "sub", "mult", "div", "pow"], ["cos", "sin", "exp", "log", "sqrt", "square"])
+
+
+def _random_tree(rng, opset, depth):
+    if depth == 0 or rng.random() < 0.3:
+        if rng.random() < 0.5:
+            return constant(float(np.float32(rng.normal())))
+        return feature(rng.integers(0, 3))
+    if opset.n_unary and rng.random() < 0.35:
+        return unary(rng.integers(0, opset.n_unary), _random_tree(rng, opset, depth - 1))
+    return binary(
+        rng.integers(0, opset.n_binary),
+        _random_tree(rng, opset, depth - 1),
+        _random_tree(rng, opset, depth - 1),
+    )
+
+
+def test_flatten_roundtrip():
+    rng = np.random.default_rng(0)
+    trees = [_random_tree(rng, OPS, 4) for _ in range(20)]
+    flat = flatten_trees(trees, max_nodes=64)
+    for i, t in enumerate(trees):
+        back = unflatten_tree(flat, i)
+        assert t.same_structure(back)
+
+
+def test_eval_matches_recursive_oracle():
+    rng = np.random.default_rng(1)
+    trees = [_random_tree(rng, OPS, 5) for _ in range(50)]
+    X = rng.normal(size=(3, 37)).astype(np.float32)
+    flat = flatten_trees(trees, max_nodes=64)
+    preds, ok = eval_trees_with_ok(flat, jnp.asarray(X), OPS)
+    preds = np.asarray(preds)
+    eps32 = np.float32(1.19e-7)
+    Xp = X * (1 + 64 * eps32)
+    for i, t in enumerate(trees):
+        want = np.asarray(t.eval_np(X, OPS))
+        got = preds[i]
+        both_nan = np.isnan(want) & np.isnan(got)
+        # Conditioning estimate: rows whose value moves a lot under a ~64-ULP
+        # input perturbation are f32-ill-conditioned (e.g. sin of a huge pow
+        # result); any two correct f32 evaluators may legitimately disagree
+        # there, so give those rows a proportionally wider budget.
+        sens = np.abs(np.asarray(t.eval_np(Xp, OPS)) - want)
+        sens = np.where(np.isfinite(sens), sens, np.inf)
+        tol = np.maximum(1e-4 + 1e-3 * np.abs(want), sens)
+        close = np.abs(want - got) <= tol
+        ill = ~np.isfinite(want)
+        assert np.all(close | both_nan | ill), (
+            f"tree {i}: {t.string_tree(OPS)}\nwant={want[:6]}\ngot={got[:6]}"
+        )
+        assert bool(ok[i]) == bool(np.all(np.isfinite(want)))
+
+
+def test_nan_detection():
+    # log of a negative value must poison the whole row set via NaN (safe-op
+    # semantics, reference src/Operators.jl:37-41 + NaN completion flag).
+    t = unary(OPS.unary_index("log"), feature(0))
+    X = np.array([[-1.0, 1.0, 2.0]], dtype=np.float32)
+    flat = flatten_trees([t], max_nodes=8)
+    preds, ok = eval_trees_with_ok(flat, jnp.asarray(X), OPS)
+    assert not bool(ok[0])
+    assert np.isnan(np.asarray(preds)[0, 0])
+    assert np.isclose(np.asarray(preds)[0, 2], np.log(2.0))
+
+
+def test_division_by_zero_inf():
+    t = binary(OPS.binary_index("div"), constant(1.0), feature(0))
+    X = np.array([[0.0, 2.0]], dtype=np.float32)
+    flat = flatten_trees([t], max_nodes=8)
+    preds, ok = eval_trees_with_ok(flat, jnp.asarray(X), OPS)
+    assert not bool(ok[0])  # Inf counts as not-completed
+    assert np.isinf(np.asarray(preds)[0, 0])
+
+
+def test_grad_wrt_constants_matches_fd():
+    # c0 * sin(c1 * x0) + c2: gradient via the custom VJP vs finite differences
+    # (mirrors the reference's derivative oracle tests, test/test_derivatives.jl).
+    c0, c1, c2 = 1.5, 0.7, -2.0
+    t = binary(
+        OPS.binary_index("add"),
+        binary(
+            OPS.binary_index("mult"),
+            constant(c0),
+            unary(OPS.unary_index("sin"), binary(OPS.binary_index("mult"), constant(c1), feature(0))),
+        ),
+        constant(c2),
+    )
+    X = np.linspace(-2, 2, 41, dtype=np.float32)[None, :]
+    y = np.sin(1.1 * X[0]).astype(np.float32)
+    flat = flatten_trees([t], max_nodes=16)
+
+    def loss_of_val(val):
+        f = flat._replace(val=val)
+        preds = eval_trees(f, jnp.asarray(X), OPS)
+        return jnp.mean((preds[0] - y) ** 2)
+
+    g = jax.grad(loss_of_val)(jnp.asarray(flat.val))
+    g = np.asarray(g)[0]
+
+    # finite differences on the live constant slots
+    val0 = np.asarray(flat.val).copy()
+    eps = 1e-3
+    for slot in range(16):
+        if np.asarray(flat.kind)[0, slot] != 1:  # KIND_CONST
+            assert g[slot] == 0.0
+            continue
+        vp = val0.copy()
+        vp[0, slot] += eps
+        vm = val0.copy()
+        vm[0, slot] -= eps
+        fd = (loss_of_val(jnp.asarray(vp)) - loss_of_val(jnp.asarray(vm))) / (2 * eps)
+        assert np.isclose(g[slot], float(fd), rtol=2e-2, atol=2e-3), (slot, g[slot], fd)
+
+
+def test_grad_wrt_features():
+    # d/dX of sum(x0 * x0) = 2 x0
+    t = binary(OPS.binary_index("mult"), feature(0), feature(0))
+    X = np.array([[1.0, 2.0, 3.0], [9.0, 9.0, 9.0]], dtype=np.float32)
+    flat = flatten_trees([t], max_nodes=8)
+
+    def s(x):
+        return eval_trees(flat, x, OPS)[0].sum()
+
+    g = np.asarray(jax.grad(s)(jnp.asarray(X)))
+    np.testing.assert_allclose(g[0], 2 * X[0], rtol=1e-5)
+    np.testing.assert_allclose(g[1], 0.0)
+
+
+def test_jit_and_vmap_compose():
+    rng = np.random.default_rng(3)
+    trees = [_random_tree(rng, OPS, 4) for _ in range(8)]
+    X = rng.normal(size=(3, 16)).astype(np.float32)
+    flat = flatten_trees(trees, max_nodes=32)
+    f = jax.jit(lambda fl, x: eval_trees(fl, x, OPS))
+    a = np.asarray(f(flat, jnp.asarray(X)))
+    b = np.asarray(eval_trees(flat, jnp.asarray(X), OPS))
+    np.testing.assert_allclose(a, b, rtol=1e-6, equal_nan=True)
+
+
+@pytest.mark.parametrize("x,y", [(2.0, 3.0), (-2.0, 3.0), (-2.0, 0.5), (0.0, -1.0), (2.0, -2.0), (0.0, 0.0)])
+def test_safe_pow_semantics(x, y):
+    # Julia reference table (/root/reference/src/Operators.jl:28-36)
+    import math
+
+    t = binary(OPS.binary_index("pow"), constant(x), constant(y))
+    X = np.zeros((1, 1), dtype=np.float32)
+    flat = flatten_trees([t], max_nodes=8)
+    got = float(np.asarray(eval_trees(flat, jnp.asarray(X), OPS))[0, 0])
+    yi = round(y)
+    if y == yi:
+        want = float("nan") if (yi < 0 and x == 0) else float(x**yi)
+    else:
+        if (y > 0 and x < 0) or (y < 0 and x <= 0):
+            want = float("nan")
+        else:
+            want = float(math.pow(x, y))
+    if math.isnan(want):
+        assert math.isnan(got)
+    else:
+        assert math.isclose(got, want, rel_tol=1e-5), (x, y, got, want)
