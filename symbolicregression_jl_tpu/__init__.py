@@ -1,0 +1,49 @@
+"""TPU-native symbolic regression framework.
+
+A from-scratch JAX/XLA re-design with the capabilities of
+SymbolicRegression.jl (reference mounted at /root/reference; see SURVEY.md):
+genetic-programming search over expression trees with tournament-based
+regularized evolution, simulated annealing, adaptive complexity-frequency
+parsimony, batched constant optimization, island populations with migration,
+and a complexity-indexed hall of fame. All scoring/optimization math runs as
+batched XLA programs on TPU; the evolutionary control loop stays on the host.
+"""
+
+from .dataset import Dataset
+from .options import MutationWeights, Options
+from .search import SearchResult, equation_search
+from .tree import Node, binary, constant, feature, unary
+from .models.hall_of_fame import HallOfFame
+from .models.population import Population
+from .models.pop_member import PopMember
+from .ops import (
+    OperatorSet,
+    eval_trees,
+    eval_trees_with_ok,
+    flatten_trees,
+    resolve_operators,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset",
+    "MutationWeights",
+    "Options",
+    "SearchResult",
+    "equation_search",
+    "Node",
+    "binary",
+    "constant",
+    "feature",
+    "unary",
+    "HallOfFame",
+    "Population",
+    "PopMember",
+    "OperatorSet",
+    "eval_trees",
+    "eval_trees_with_ok",
+    "flatten_trees",
+    "resolve_operators",
+    "__version__",
+]

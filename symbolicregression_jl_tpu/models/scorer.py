@@ -1,0 +1,106 @@
+"""BatchScorer: the host<->device boundary of the search.
+
+The single most important architectural change vs. the reference: where the
+reference calls ``score_func`` (one recursive eval) per mutation
+(/root/reference/src/Mutate.jl:268-274), here every scoring request is queued
+and evaluated as ONE batched XLA program over all candidate trees — across all
+islands in a lockstep cycle. Host<->device traffic is flattened tree tensors
+in, loss vectors out.
+
+Compile discipline (SURVEY.md §7.3): candidate-batch sizes are padded to
+power-of-two buckets and node counts to a fixed budget, so a whole search
+compiles a handful of programs, all cached.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..ops.flat import batch_bucket as _bucket
+from ..ops.flat import flatten_trees
+from ..ops.scoring import batched_loss_jit, baseline_loss, loss_to_score
+from ..tree import Node
+
+__all__ = ["BatchScorer"]
+
+
+class BatchScorer:
+    def __init__(self, dataset: Dataset, options):
+        self.dataset = dataset
+        self.options = options
+        self.opset = options.operators
+        self.loss_elem = options.loss
+        self.dtype = options.dtype
+        self.max_nodes = options.max_nodes
+        X, y, w = dataset.device_arrays(self.dtype)
+        self.X, self.y, self.w = X, y, w
+        bl, use = baseline_loss(dataset, self.opset, self.loss_elem, self.dtype)
+        dataset.baseline_loss = bl
+        dataset.use_baseline = use
+        self.num_evals = 0.0
+
+    # -- losses --------------------------------------------------------------
+
+    def loss_many_async(self, trees: list[Node], idx: np.ndarray | None = None):
+        """Dispatch a scoring batch WITHOUT blocking on the result.
+
+        Returns a zero-arg callable that materializes the numpy losses. This is
+        the latency-hiding half of the pipeline: `jax.jit` dispatch is async,
+        so the host can keep proposing/applying evolution events while the
+        device computes and the readback is in flight."""
+        if not trees:
+            return lambda: np.zeros((0,))
+        P = len(trees)
+        bucket = _bucket(P)
+        padded = trees + [trees[0]] * (bucket - P)
+        flat = flatten_trees(padded, self.max_nodes, dtype=self.dtype)
+        if idx is None:
+            X, y, w = self.X, self.y, self.w
+            self.num_evals += P
+        else:
+            X = self.X[:, idx]
+            y = self.y[idx]
+            w = None if self.w is None else self.w[idx]
+            self.num_evals += P * (len(idx) / self.dataset.n)
+        dev_losses = batched_loss_jit(flat, X, y, w, self.opset, self.loss_elem)
+        try:
+            dev_losses.copy_to_host_async()
+        except Exception:
+            pass
+
+        def materialize() -> np.ndarray:
+            return np.asarray(dev_losses)[:P].astype(np.float64)
+
+        return materialize
+
+    def loss_many(self, trees: list[Node], idx: np.ndarray | None = None) -> np.ndarray:
+        """Full-data (or row-subset) losses for a batch of trees. Returns
+        float64 numpy [len(trees)]; inf = invalid candidate."""
+        return self.loss_many_async(trees, idx=idx)()
+
+    def batch_indices(self, rng: np.random.Generator) -> np.ndarray | None:
+        """With-replacement minibatch row indices (reference: batch_sample,
+        /root/reference/src/LossFunctions.jl:125-127); None when not batching."""
+        if not self.options.batching:
+            return None
+        return rng.integers(0, self.dataset.n, size=self.options.batch_size)
+
+    # -- scores --------------------------------------------------------------
+
+    def score_of(self, loss: np.ndarray, complexity: np.ndarray) -> np.ndarray:
+        return loss_to_score(
+            loss,
+            complexity,
+            use_baseline=self.dataset.use_baseline,
+            baseline=self.dataset.baseline_loss,
+            parsimony=self.options.parsimony,
+        )
+
+    def score_trees(
+        self, trees: list[Node], complexities, idx: np.ndarray | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(scores, losses) for a batch of trees."""
+        losses = self.loss_many(trees, idx=idx)
+        scores = self.score_of(losses, np.asarray(complexities))
+        return scores, losses

@@ -74,6 +74,16 @@ def pad_bucket(n: int, multiple: int = 8) -> int:
     return max(multiple, ((n + multiple - 1) // multiple) * multiple)
 
 
+def batch_bucket(n: int, minimum: int = 16) -> int:
+    """Round a candidate-batch size up to a power-of-two bucket — the shared
+    policy for every batched device program (scoring, constant optimization),
+    bounding the compile-cache population to O(log P)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
 def flatten_trees(
     trees: list[Node], max_nodes: int, dtype=np.float32
 ) -> FlatTrees:

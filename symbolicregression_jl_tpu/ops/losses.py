@@ -1,0 +1,194 @@
+"""Elementwise loss zoo — closed-form JAX implementations.
+
+Replaces LossFunctions.jl as consumed by the reference
+(/root/reference/src/LossFunctions.jl:13-33 for the weighted normalized mean;
+the 26 re-exported losses at /root/reference/src/SymbolicRegression.jl:101-127).
+Distance losses take (pred, target); margin losses take (target, agreement)
+with targets in {-1, +1}, following the same convention.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+__all__ = ["LOSSES", "resolve_loss", "weighted_mean_loss", "L2DistLoss"]
+
+
+# -- distance-based losses: f(difference) ------------------------------------
+
+
+def L2DistLoss(pred, target):
+    d = pred - target
+    return d * d
+
+
+def L1DistLoss(pred, target):
+    return jnp.abs(pred - target)
+
+
+def LPDistLoss(p: float) -> Callable:
+    def loss(pred, target):
+        return jnp.abs(pred - target) ** p
+
+    loss.__name__ = f"LPDistLoss({p})"
+    return loss
+
+
+def HuberLoss(d: float = 1.0) -> Callable:
+    def loss(pred, target):
+        a = jnp.abs(pred - target)
+        return jnp.where(a <= d, 0.5 * a * a, d * (a - 0.5 * d))
+
+    loss.__name__ = f"HuberLoss({d})"
+    return loss
+
+
+def L1EpsilonInsLoss(eps: float = 1.0) -> Callable:
+    def loss(pred, target):
+        return jnp.maximum(jnp.abs(pred - target) - eps, 0.0)
+
+    loss.__name__ = f"L1EpsilonInsLoss({eps})"
+    return loss
+
+
+def L2EpsilonInsLoss(eps: float = 1.0) -> Callable:
+    def loss(pred, target):
+        e = jnp.maximum(jnp.abs(pred - target) - eps, 0.0)
+        return e * e
+
+    loss.__name__ = f"L2EpsilonInsLoss({eps})"
+    return loss
+
+
+def LogitDistLoss(pred, target):
+    d = pred - target
+    return -jnp.log(4.0) - d + 2.0 * jnp.log1p(jnp.exp(d))
+
+
+def PeriodicLoss(c: float = 1.0) -> Callable:
+    def loss(pred, target):
+        return 2.0 * jnp.sin(jnp.pi * (pred - target) / c) ** 2
+
+    loss.__name__ = f"PeriodicLoss({c})"
+    return loss
+
+
+def QuantileLoss(tau: float = 0.5) -> Callable:
+    def loss(pred, target):
+        d = target - pred
+        return jnp.where(d >= 0, tau * d, (tau - 1.0) * d)
+
+    loss.__name__ = f"QuantileLoss({tau})"
+    return loss
+
+
+# -- margin-based losses: f(agreement = pred * target), target in {-1, 1} ----
+
+
+def _margin(fn):
+    def loss(pred, target):
+        return fn(pred * target)
+
+    return loss
+
+
+ZeroOneLoss = _margin(lambda a: (a < 0).astype(jnp.result_type(a)))
+PerceptronLoss = _margin(lambda a: jnp.maximum(-a, 0.0))
+L1HingeLoss = _margin(lambda a: jnp.maximum(1.0 - a, 0.0))
+L2HingeLoss = _margin(lambda a: jnp.maximum(1.0 - a, 0.0) ** 2)
+ExpLoss = _margin(lambda a: jnp.exp(-a))
+SigmoidLoss = _margin(lambda a: (1.0 - jnp.tanh(a)))
+L2MarginLoss = _margin(lambda a: (1.0 - a) ** 2)
+ModifiedHuberLoss = _margin(
+    lambda a: jnp.where(a >= -1.0, jnp.maximum(1.0 - a, 0.0) ** 2, -4.0 * a)
+)
+LogitMarginLoss = _margin(lambda a: jnp.log1p(jnp.exp(-a)))
+
+
+def SmoothedL1HingeLoss(gamma: float = 1.0) -> Callable:
+    def fn(a):
+        return jnp.where(
+            a >= 1.0 - gamma,
+            jnp.maximum(1.0 - a, 0.0) ** 2 / (2.0 * gamma),
+            1.0 - gamma / 2.0 - a,
+        )
+
+    loss = _margin(fn)
+    loss.__name__ = f"SmoothedL1HingeLoss({gamma})"
+    return loss
+
+
+def DWDMarginLoss(q: float = 1.0) -> Callable:
+    def fn(a):
+        thresh = q / (q + 1.0)
+        const = (q**q) / ((q + 1.0) ** (q + 1.0))
+        safe = jnp.where(a > 0, a, 1.0)
+        return jnp.where(a <= thresh, 1.0 - a, const / safe**q)
+
+    loss = _margin(fn)
+    loss.__name__ = f"DWDMarginLoss({q})"
+    return loss
+
+
+LOSSES: dict[str, Callable] = {
+    "L2DistLoss": L2DistLoss,
+    "L1DistLoss": L1DistLoss,
+    "LogitDistLoss": LogitDistLoss,
+    "ZeroOneLoss": ZeroOneLoss,
+    "PerceptronLoss": PerceptronLoss,
+    "L1HingeLoss": L1HingeLoss,
+    "L2HingeLoss": L2HingeLoss,
+    "ExpLoss": ExpLoss,
+    "SigmoidLoss": SigmoidLoss,
+    "L2MarginLoss": L2MarginLoss,
+    "ModifiedHuberLoss": ModifiedHuberLoss,
+    "LogitMarginLoss": LogitMarginLoss,
+    # parameterized factories, default-instantiated under their plain names:
+    "HuberLoss": HuberLoss(1.0),
+    "L1EpsilonInsLoss": L1EpsilonInsLoss(1.0),
+    "L2EpsilonInsLoss": L2EpsilonInsLoss(1.0),
+    "PeriodicLoss": PeriodicLoss(1.0),
+    "QuantileLoss": QuantileLoss(0.5),
+    "SmoothedL1HingeLoss": SmoothedL1HingeLoss(1.0),
+    "DWDMarginLoss": DWDMarginLoss(1.0),
+}
+
+_FACTORIES = {
+    "LPDistLoss": LPDistLoss,
+    "HuberLoss": HuberLoss,
+    "L1EpsilonInsLoss": L1EpsilonInsLoss,
+    "L2EpsilonInsLoss": L2EpsilonInsLoss,
+    "PeriodicLoss": PeriodicLoss,
+    "QuantileLoss": QuantileLoss,
+    "SmoothedL1HingeLoss": SmoothedL1HingeLoss,
+    "DWDMarginLoss": DWDMarginLoss,
+}
+
+
+def resolve_loss(spec) -> Callable:
+    """name | callable | None -> elementwise loss fn(pred, target).
+    Default: L2 (reference default, /root/reference/src/Options.jl:534-535)."""
+    if spec is None:
+        return L2DistLoss
+    if callable(spec):
+        return spec
+    if isinstance(spec, str):
+        if spec in LOSSES:
+            return LOSSES[spec]
+        # parameterized form "HuberLoss(0.5)"
+        if "(" in spec and spec.endswith(")"):
+            name, argstr = spec.split("(", 1)
+            if name in _FACTORIES:
+                return _FACTORIES[name](float(argstr[:-1]))
+        raise KeyError(f"unknown loss {spec!r}; known: {sorted(LOSSES)}")
+    raise TypeError(f"cannot interpret loss spec {spec!r}")
+
+
+def weighted_mean_loss(elem, weights=None):
+    """Weighted normalized mean, matching LossFunctions.jl `AggMode.WeightedMean`
+    as used by the reference (/root/reference/src/LossFunctions.jl:27-28)."""
+    if weights is None:
+        return jnp.mean(elem, axis=-1)
+    return jnp.sum(elem * weights, axis=-1) / jnp.sum(weights, axis=-1)

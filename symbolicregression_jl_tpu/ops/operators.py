@@ -413,3 +413,115 @@ def default_operator_set() -> OperatorSet:
     # Reference default: binary [+, -, /, *], no unary
     # (/root/reference/src/Options.jl defaults).
     return resolve_operators(["add", "sub", "div", "mult"], [])
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python scalar implementations (host-side constant folding & friends).
+# Device dispatch of single scalars is pure overhead (and catastrophic over a
+# tunneled TPU), so host passes use these. Semantics match the JAX table
+# exactly, including the NaN guards.
+# ---------------------------------------------------------------------------
+
+import math as _math
+
+_NAN = float("nan")
+
+
+def _s_pow(x, y):
+    yi = round(y)
+    if y == yi:
+        if yi < 0 and x == 0:
+            return _NAN
+        try:
+            return float(_math.pow(abs(x), y)) * (-1.0 if (x < 0 and yi % 2) else 1.0)
+        except OverflowError:
+            return float("inf")
+    if (y > 0 and x < 0) or (y < 0 and x <= 0):
+        return _NAN
+    try:
+        return float(_math.pow(x, y))
+    except OverflowError:
+        return float("inf")
+
+
+def _s_gamma(x):
+    try:
+        v = _math.gamma(x)
+    except (ValueError, OverflowError):
+        return _NAN
+    return v if _math.isfinite(v) else _NAN
+
+
+def _s_div(x, y):
+    if y == 0:
+        if x == 0 or _math.isnan(x):
+            return _NAN
+        return _math.copysign(float("inf"), x) * _math.copysign(1.0, y)
+    return x / y
+
+
+def _guard_s(fn, cond):
+    def impl(x):
+        if _math.isnan(x) or cond(x):
+            return _NAN
+        return float(fn(x))
+
+    return impl
+
+
+SCALAR_IMPLS: dict[str, Callable] = {
+    "neg": lambda x: -x,
+    "square": lambda x: x * x,
+    "cube": lambda x: x * x * x,
+    "exp": lambda x: _math.exp(x) if x < 709 else float("inf"),
+    "abs": abs,
+    "log": _guard_s(_math.log, lambda x: x <= 0),
+    "log2": _guard_s(_math.log2, lambda x: x <= 0),
+    "log10": _guard_s(_math.log10, lambda x: x <= 0),
+    "log1p": _guard_s(_math.log1p, lambda x: x <= -1),
+    "sqrt": _guard_s(_math.sqrt, lambda x: x < 0),
+    "sin": _math.sin,
+    "cos": _math.cos,
+    "tan": _math.tan,
+    "sinh": lambda x: _math.sinh(x) if abs(x) < 710 else _math.copysign(float("inf"), x),
+    "cosh": lambda x: _math.cosh(x) if abs(x) < 710 else float("inf"),
+    "tanh": _math.tanh,
+    "asin": _guard_s(_math.asin, lambda x: abs(x) > 1),
+    "acos": _guard_s(_math.acos, lambda x: abs(x) > 1),
+    "atan": _math.atan,
+    "asinh": _math.asinh,
+    "acosh": _guard_s(_math.acosh, lambda x: x < 1),
+    "atanh": _guard_s(_math.atanh, lambda x: abs(x) >= 1),
+    "atanh_clip": lambda x: _guard_s(_math.atanh, lambda v: abs(v) >= 1)(
+        _math.fmod(_math.fmod(x + 1.0, 2.0) + 2.0, 2.0) - 1.0
+    ),
+    "erf": _math.erf,
+    "erfc": _math.erfc,
+    "gamma": _s_gamma,
+    "relu": lambda x: x if x > 0 else 0.0,
+    "round": lambda x: float(np.round(x)),  # banker's rounding, like jnp.round
+    "floor": _math.floor,
+    "ceil": _math.ceil,
+    "sign": lambda x: _NAN if _math.isnan(x) else float(np.sign(x)),
+    "add": lambda x, y: x + y,
+    "sub": lambda x, y: x - y,
+    "mult": lambda x, y: x * y,
+    "div": _s_div,
+    "pow": _s_pow,
+    "mod": lambda x, y: _NAN if y == 0 else _math.fmod(_math.fmod(x, y) + y, y),
+    "greater": lambda x, y: 1.0 if x > y else 0.0,
+    "cond": lambda x, y: y if x > 0 else 0.0,
+    "logical_or": lambda x, y: 1.0 if (x > 0 or y > 0) else 0.0,
+    "logical_and": lambda x, y: 1.0 if (x > 0 and y > 0) else 0.0,
+    "max": lambda x, y: max(x, y),
+    "min": lambda x, y: min(x, y),
+}
+
+
+def scalar_impl(op: Operator) -> Callable:
+    """Host scalar implementation of an operator; falls back to the JAX fn
+    (slow but always correct) for user-defined operators."""
+    fn = SCALAR_IMPLS.get(op.name)
+    if fn is not None:
+        return fn
+    return lambda *args: float(np.asarray(op.fn(*[np.float64(a) for a in args])))

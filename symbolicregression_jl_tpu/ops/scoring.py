@@ -1,0 +1,88 @@
+"""Batched scoring: the device half of the hot loop.
+
+Replaces the reference's score_func / eval_loss path
+(/root/reference/src/LossFunctions.jl:97-194). The key restructuring vs. the
+reference: scoring is *batched* — every call evaluates a whole batch of
+candidate trees against the dataset as ONE jitted XLA program, instead of one
+recursive eval per mutation. Incomplete evaluations (NaN/Inf at the root) get
+``inf`` loss (/root/reference/src/LossFunctions.jl:55-57).
+
+``loss_to_score`` is host-side numpy (cheap, per-candidate scalars):
+score = loss / max(baseline, 0.01) + complexity * parsimony
+(/root/reference/src/LossFunctions.jl:138-158).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .flat import FlatTrees
+from .interp import eval_trees
+from .losses import weighted_mean_loss
+from .operators import OperatorSet
+
+__all__ = [
+    "batched_loss",
+    "batched_loss_jit",
+    "loss_to_score",
+    "baseline_loss",
+]
+
+
+def batched_loss(
+    flat: FlatTrees,
+    X: jax.Array,
+    y: jax.Array,
+    weights: jax.Array | None,
+    opset: OperatorSet,
+    loss_elem: Callable,
+) -> jax.Array:
+    """Losses for a batch of trees: [P]. inf where evaluation is invalid."""
+    preds = eval_trees(flat, X, opset)
+    elem = loss_elem(preds, y[None, :])
+    losses = weighted_mean_loss(elem, None if weights is None else weights[None, :])
+    ok = jnp.isfinite(preds).all(axis=-1)
+    return jnp.where(ok, losses, jnp.inf)
+
+
+@functools.partial(jax.jit, static_argnames=("opset", "loss_elem", "has_weights"))
+def _batched_loss_jit(flat, X, y, weights, opset, loss_elem, has_weights):
+    return batched_loss(flat, X, y, weights if has_weights else None, opset, loss_elem)
+
+
+def batched_loss_jit(flat, X, y, weights, opset, loss_elem) -> jax.Array:
+    """Jitted entry point; weights=None handled via a static flag so the
+    compiled program count stays O(1)."""
+    has_weights = weights is not None
+    w = weights if has_weights else jnp.zeros((), X.dtype)
+    return _batched_loss_jit(flat, X, y, w, opset, loss_elem, has_weights)
+
+
+def loss_to_score(
+    loss,
+    complexity,
+    *,
+    use_baseline: bool,
+    baseline: float,
+    parsimony: float,
+):
+    """Normalized loss + parsimony penalty (host-side numpy; see module doc)."""
+    normalization = baseline if (use_baseline and baseline >= 0.01) else 0.01
+    return np.asarray(loss) / normalization + np.asarray(complexity) * parsimony
+
+
+def baseline_loss(dataset, opset: OperatorSet, loss_elem, dtype=np.float32):
+    """Loss of the constant avg_y predictor (reference: update_baseline_loss!,
+    /root/reference/src/LossFunctions.jl:201-215). Returns (baseline, use)."""
+    X, y, w = dataset.device_arrays(dtype)
+    pred = jnp.full_like(y, dataset.avg_y)
+    elem = loss_elem(pred[None, :], y[None, :])
+    val = float(weighted_mean_loss(elem, None if w is None else w[None, :])[0])
+    if np.isfinite(val):
+        return val, True
+    return 1.0, False

@@ -1,0 +1,290 @@
+"""Search configuration.
+
+TPU-first counterpart of the reference's Options layer
+(/root/reference/src/Options.jl:379-453 for default values,
+/root/reference/src/OptionsStruct.jl:123-195 for the struct,
+/root/reference/src/MutationWeights.jl:30-43 for mutation weights). Defaults
+mirror the reference so search dynamics are comparable out of the box.
+
+Host/device split: ``Options`` itself is a host object and never crosses into
+jit. The pieces the device kernels need — the resolved ``OperatorSet``, the
+elementwise loss, dtype, padded node budget — are exposed as hashable static
+attributes, so each (operator set, shape bucket) compiles exactly one XLA
+program per kernel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .ops.losses import resolve_loss
+from .ops.operators import OperatorSet, resolve_operators
+from .ops.flat import pad_bucket
+
+__all__ = ["MutationWeights", "Options"]
+
+
+@dataclasses.dataclass
+class MutationWeights:
+    """Relative frequencies of the mutation kinds
+    (reference defaults: /root/reference/src/MutationWeights.jl:30-43)."""
+
+    mutate_constant: float = 0.048
+    mutate_operator: float = 0.47
+    swap_operands: float = 0.1
+    add_node: float = 0.79
+    insert_node: float = 5.1
+    delete_node: float = 1.7
+    simplify: float = 0.0020
+    randomize: float = 0.00023
+    do_nothing: float = 0.21
+    optimize: float = 0.0
+    form_connection: float = 0.5
+    break_connection: float = 0.1
+
+    NAMES = (
+        "mutate_constant",
+        "mutate_operator",
+        "swap_operands",
+        "add_node",
+        "insert_node",
+        "delete_node",
+        "simplify",
+        "randomize",
+        "do_nothing",
+        "optimize",
+        "form_connection",
+        "break_connection",
+    )
+
+    def as_vector(self) -> np.ndarray:
+        return np.array([getattr(self, n) for n in self.NAMES], dtype=np.float64)
+
+    def copy(self) -> "MutationWeights":
+        return dataclasses.replace(self)
+
+    def sample(self, rng: np.random.Generator, weights: np.ndarray | None = None) -> str:
+        """Weighted draw of a mutation kind
+        (reference: sample_mutation, /root/reference/src/MutationWeights.jl:61-64)."""
+        w = self.as_vector() if weights is None else weights
+        total = w.sum()
+        if total <= 0:
+            return "do_nothing"
+        return self.NAMES[rng.choice(len(w), p=w / total)]
+
+
+@dataclasses.dataclass
+class Options:
+    """All search hyperparameters. Field names and defaults track the
+    reference's Options constructor (/root/reference/src/Options.jl:379-453);
+    TPU-specific knobs are grouped at the bottom."""
+
+    # -- operators & losses --------------------------------------------------
+    binary_operators: Sequence[Any] = ("+", "-", "/", "*")
+    unary_operators: Sequence[Any] = ()
+    elementwise_loss: Any = None  # name | callable(pred, target [,weight]); default L2
+    loss_function: Callable | None = None  # full-objective override (host-side)
+
+    # -- complexity / constraints -------------------------------------------
+    maxsize: int = 20
+    maxdepth: int | None = None
+    constraints: dict | None = None  # op-name -> int | (int,int) subtree-size caps
+    nested_constraints: dict | None = None  # op -> {op -> max nesting}
+    complexity_of_operators: dict | None = None  # op-name -> complexity
+    complexity_of_constants: float | None = None
+    complexity_of_variables: float | Sequence[float] | None = None
+    parsimony: float = 0.0032
+    use_frequency: bool = True
+    use_frequency_in_tournament: bool = True
+    adaptive_parsimony_scaling: float = 20.0
+    warmup_maxsize_by: float = 0.0
+
+    # -- evolution -----------------------------------------------------------
+    populations: int = 15
+    population_size: int = 33
+    ncycles_per_iteration: int = 550
+    tournament_selection_n: int = 12
+    tournament_selection_p: float = 0.86
+    topn: int = 12
+    crossover_probability: float = 0.066
+    annealing: bool = False
+    alpha: float = 0.1
+    perturbation_factor: float = 0.076
+    probability_negate_constant: float = 0.01
+    mutation_weights: MutationWeights = dataclasses.field(default_factory=MutationWeights)
+    skip_mutation_failures: bool = True
+    migration: bool = True
+    hof_migration: bool = True
+    fraction_replaced: float = 0.00036
+    fraction_replaced_hof: float = 0.035
+    should_simplify: bool | None = None
+    should_optimize_constants: bool = True
+
+    # -- constant optimizer --------------------------------------------------
+    optimizer_algorithm: str = "BFGS"
+    optimizer_probability: float = 0.14
+    optimizer_nrestarts: int = 2
+    optimizer_iterations: int = 8
+    optimizer_f_calls_limit: int | None = None
+
+    # -- batching ------------------------------------------------------------
+    batching: bool = False
+    batch_size: int = 50
+
+    # -- run control ---------------------------------------------------------
+    early_stop_condition: float | Callable | None = None
+    timeout_in_seconds: float | None = None
+    max_evals: int | None = None
+    seed: int | None = None
+    deterministic: bool = False
+    verbosity: int | None = None
+    progress: bool | None = None
+    print_precision: int = 5
+    save_to_file: bool = True
+    output_file: str | None = None
+    use_recorder: bool = False
+    recorder_file: str = "sr_recorder.json"
+
+    # -- TPU-specific --------------------------------------------------------
+    dtype: Any = np.float32  # device compute dtype for eval/scoring
+    pad_multiple: int = 8  # node-slot padding bucket (compile-cache granularity)
+    scheduler: str = "lockstep"  # "lockstep" (vectorized islands) | "async"
+    data_sharding: str | None = None  # "rows" to shard dataset rows over devices
+
+    # -- derived (filled in __post_init__) -----------------------------------
+    operators: OperatorSet = dataclasses.field(init=False)
+    loss: Callable = dataclasses.field(init=False)
+    max_nodes: int = dataclasses.field(init=False)
+
+    def __post_init__(self):
+        self.operators = resolve_operators(self.binary_operators, self.unary_operators)
+        self.loss = resolve_loss(self.elementwise_loss)
+        if self.maxdepth is None:
+            self.maxdepth = self.maxsize
+        if self.should_simplify is None:
+            # Reference disables auto-simplify when a full custom objective is
+            # used (the objective may depend on exact tree shape).
+            self.should_simplify = self.loss_function is None
+        # +2 head-room matches the reference's hall-of-fame sizing
+        # (members[1:maxsize+MAX_DEGREE], /root/reference/src/HallOfFame.jl:45-63).
+        self.max_nodes = pad_bucket(self.maxsize + 2, self.pad_multiple)
+        if self.deterministic and self.seed is None:
+            self.seed = 0
+
+        self._op_constraints = _normalize_constraints(self.constraints, self.operators)
+        self._nested_constraints = _normalize_nested(
+            self.nested_constraints, self.operators
+        )
+        self._complexity_mapping = _complexity_mapping(self)
+        # Geometric tournament weights p*(1-p)^k, precomputed like the
+        # reference (/root/reference/src/Options.jl:713-720).
+        p = self.tournament_selection_p
+        n = self.tournament_selection_n
+        w = p * (1 - p) ** np.arange(n)
+        self._tournament_weights = w / w.sum()
+
+    # hooks used across the stack ------------------------------------------
+
+    @property
+    def op_constraints(self):
+        return self._op_constraints
+
+    @property
+    def nested_constraints_resolved(self):
+        return self._nested_constraints
+
+    @property
+    def complexity_mapping(self):
+        return self._complexity_mapping
+
+    @property
+    def tournament_weights(self) -> np.ndarray:
+        return self._tournament_weights
+
+    def early_stop_fn(self) -> Callable | None:
+        """Scalar threshold -> closure, as in the reference
+        (/root/reference/src/Options.jl:683-689)."""
+        cond = self.early_stop_condition
+        if cond is None:
+            return None
+        if callable(cond):
+            return cond
+        thresh = float(cond)
+        return lambda loss, complexity: loss < thresh
+
+
+def _normalize_constraints(constraints, opset: OperatorSet):
+    """Per-operator subtree-size caps -> (bin_caps, una_caps) index arrays.
+    -1 = unconstrained. Reference: build_constraints
+    (/root/reference/src/Options.jl:39-90)."""
+    bin_caps = [(-1, -1)] * opset.n_binary
+    una_caps = [-1] * opset.n_unary
+    if constraints:
+        for name, cap in constraints.items():
+            try:
+                i = opset.binary_index(name)
+                if isinstance(cap, int):
+                    cap = (cap, cap)
+                bin_caps[i] = (int(cap[0]), int(cap[1]))
+                continue
+            except KeyError:
+                pass
+            i = opset.unary_index(name)
+            una_caps[i] = int(cap) if not isinstance(cap, (tuple, list)) else int(cap[0])
+    return tuple(bin_caps), tuple(una_caps)
+
+
+def _normalize_nested(nested, opset: OperatorSet):
+    """{outer op: {inner op: max times inner may appear under outer}} ->
+    [(outer_deg, outer_idx, [(inner_deg, inner_idx, max), ...])]. Matches the
+    reference's compiled-tuple form (/root/reference/src/Options.jl:571-626)."""
+    if not nested:
+        return ()
+
+    def locate(name):
+        try:
+            return 2, opset.binary_index(name)
+        except KeyError:
+            return 1, opset.unary_index(name)
+
+    out = []
+    for outer, inners in nested.items():
+        odeg, oidx = locate(outer)
+        compiled = tuple(
+            (*locate(inner), int(maxn)) for inner, maxn in inners.items()
+        )
+        out.append((odeg, oidx, compiled))
+    return tuple(out)
+
+
+def _complexity_mapping(o: Options):
+    """Per-op/variable/constant complexities (reference: ComplexityMapping,
+    /root/reference/src/OptionsStruct.jl:21-113). None -> plain node count."""
+    custom = (
+        o.complexity_of_operators is not None
+        or o.complexity_of_constants is not None
+        or o.complexity_of_variables is not None
+    )
+    if not custom:
+        return None
+    binop = np.ones(o.operators.n_binary)
+    unaop = np.ones(o.operators.n_unary)
+    if o.complexity_of_operators:
+        for name, c in o.complexity_of_operators.items():
+            try:
+                binop[o.operators.binary_index(name)] = c
+            except KeyError:
+                unaop[o.operators.unary_index(name)] = c
+    const_c = 1.0 if o.complexity_of_constants is None else float(o.complexity_of_constants)
+    var_c = o.complexity_of_variables
+    if var_c is None:
+        var_c = 1.0
+    return {
+        "binop": binop,
+        "unaop": unaop,
+        "constant": const_c,
+        "variable": np.asarray(var_c, dtype=np.float64),
+    }

@@ -1,0 +1,302 @@
+"""equation_search: the top-level search driver (L4).
+
+Reference: /root/reference/src/SymbolicRegression.jl:360-1129. Keeps the
+6-phase driver shape (validate -> create -> initialize -> warmup -> main loop
+-> teardown) but replaces the async per-island task scheduler with the
+TPU-native **lockstep island scheduler**: all islands of an output advance
+together so that every cycle's candidate scoring, and every iteration's
+constant optimization, is one large batched XLA program. (An async mode in the
+reference's style remains available through `parallel/islands.py` for
+multi-host runs.)
+
+Budget semantics match the reference: ``niterations`` full iterations per
+output, each = ``ncycles_per_iteration`` evolve passes per island
+(/root/reference/src/SymbolicRegression.jl:575).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from .dataset import Dataset
+from .models.adaptive_parsimony import RunningSearchStatistics
+from .models.hall_of_fame import HallOfFame
+from .models.migration import migrate
+from .models.pop_member import PopMember
+from .models.population import Population
+from .models.scorer import BatchScorer
+from .models.single_iteration import (
+    optimize_and_simplify_populations,
+    s_r_cycle_lockstep,
+)
+from .options import Options
+from .utils.export_csv import save_hall_of_fame
+from .complexity import compute_complexity
+
+__all__ = ["equation_search", "SearchResult"]
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """Per-output search output: hall of fame + final island populations
+    (the reference's return_state tuple, /root/reference/src/SymbolicRegression.jl:1079-1086)."""
+
+    hall_of_fame: HallOfFame
+    populations: list[Population]
+    dataset: Dataset
+    options: Options
+    num_evals: float
+
+    @property
+    def pareto_frontier(self):
+        return self.hall_of_fame.pareto_frontier()
+
+    def report(self):
+        return self.hall_of_fame.format(self.options, self.dataset.variable_names)
+
+    def best(self) -> PopMember:
+        """Best expression by the reference's selection rule: highest score
+        among frontier members with loss <= 1.5x min loss
+        (/root/reference/src/MLJInterface.jl:399-408)."""
+        rows = self.report()
+        if not rows:
+            raise ValueError("empty hall of fame")
+        min_loss = min(r["loss"] for r in rows)
+        eligible = [r for r in rows if r["loss"] <= 1.5 * min_loss]
+        return max(eligible, key=lambda r: r["score"])["member"]
+
+
+def get_cur_maxsize(iteration: int, niterations: int, options: Options) -> int:
+    """Warmup schedule 3 -> maxsize over `warmup_maxsize_by` fraction of the
+    budget (reference: get_cur_maxsize, /root/reference/src/SearchUtils.jl:458-470)."""
+    if options.warmup_maxsize_by <= 0:
+        return options.maxsize
+    fraction = iteration / max(niterations, 1)
+    in_warmup = fraction / options.warmup_maxsize_by
+    cur = 3 + int(in_warmup * (options.maxsize - 3))
+    return min(cur, options.maxsize)
+
+
+def _init_population(
+    scorer: BatchScorer, options: Options, nfeatures: int, rng: np.random.Generator
+) -> Population:
+    trees = Population.random_trees(options.population_size, options, nfeatures, rng)
+    comps = [compute_complexity(t, options) for t in trees]
+    scores, losses = scorer.score_trees(trees, comps)
+    members = []
+    for t, s, l, c in zip(trees, scores, losses, comps):
+        m = PopMember(t, s, l, complexity=c)
+        members.append(m)
+    return Population(members)
+
+
+def _rescore_population(
+    pop: Population, scorer: BatchScorer, options: Options
+) -> Population:
+    trees = [m.tree for m in pop.members]
+    comps = [m.get_complexity(options) for m in pop.members]
+    scores, losses = scorer.score_trees(trees, comps)
+    for m, s, l in zip(pop.members, scores, losses):
+        m.score, m.loss = float(s), float(l)
+    return pop
+
+
+def _search_one_output(
+    dataset: Dataset,
+    options: Options,
+    niterations: int,
+    rng: np.random.Generator,
+    saved_state: SearchResult | None = None,
+    verbosity: int = 1,
+    output_file: str | None = None,
+) -> SearchResult:
+    scorer = BatchScorer(dataset, options)
+    nfeatures = dataset.n_features
+
+    # -- initialize (warm start re-scores saved members: reference
+    #    _initialize_search!, /root/reference/src/SymbolicRegression.jl:722-795)
+    hof = HallOfFame(options.maxsize)
+    if saved_state is not None:
+        pops = []
+        for pop in saved_state.populations:
+            pop = pop.copy()
+            if pop.n != options.population_size:
+                pops.append(_init_population(scorer, options, nfeatures, rng))
+            else:
+                pops.append(_rescore_population(pop, scorer, options))
+        while len(pops) < options.populations:
+            pops.append(_init_population(scorer, options, nfeatures, rng))
+        pops = pops[: options.populations]
+        saved_members = [m.copy() for m in saved_state.hall_of_fame.members if m is not None]
+        if saved_members:
+            losses = scorer.loss_many([m.tree for m in saved_members])
+            comps = [m.get_complexity(options) for m in saved_members]
+            scores = scorer.score_of(losses, np.asarray(comps))
+            for m, l, s in zip(saved_members, losses, scores):
+                m.loss, m.score = float(l), float(s)
+                hof.update(m, options)
+    else:
+        pops = [
+            _init_population(scorer, options, nfeatures, rng)
+            for _ in range(options.populations)
+        ]
+
+    stats = RunningSearchStatistics(options.maxsize)
+    stats_list = [stats] * len(pops)  # shared: lockstep updates at barriers only
+    early_stop = options.early_stop_fn()
+    start_time = time.time()
+    stop_reason = None
+
+    for iteration in range(niterations):
+        curmaxsize = get_cur_maxsize(iteration, niterations, options)
+
+        best_seen = s_r_cycle_lockstep(
+            pops,
+            scorer,
+            options.ncycles_per_iteration,
+            curmaxsize,
+            stats_list,
+            options,
+            nfeatures,
+            rng,
+        )
+        optimize_and_simplify_populations(pops, scorer, options, rng)
+
+        # merge halls of fame + frequency stats (head-side merge in the
+        # reference main loop, /root/reference/src/SymbolicRegression.jl:916-926)
+        for bs in best_seen:
+            hof.merge(bs, options)
+        for pop in pops:
+            hof.update_many(pop.members, options)
+            for m in pop.members:
+                stats.update(m.get_complexity(options))
+        stats.move_window()
+        stats.normalize()
+
+        # migration (reference: /root/reference/src/SymbolicRegression.jl:933-943)
+        if options.migration:
+            all_best = [
+                m
+                for pop in pops
+                for m in pop.best_sub_pop(options.topn).members
+            ]
+            for pop in pops:
+                migrate(all_best, pop, options, options.fraction_replaced, rng)
+        if options.hof_migration:
+            frontier = hof.pareto_frontier()
+            for pop in pops:
+                migrate(frontier, pop, options, options.fraction_replaced_hof, rng)
+
+        if output_file and options.save_to_file:
+            save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+
+        if verbosity > 0:
+            elapsed = time.time() - start_time
+            print(
+                f"[iter {iteration + 1}/{niterations}] "
+                f"evals={scorer.num_evals:.3g} elapsed={elapsed:.1f}s "
+                f"evals/s={scorer.num_evals / max(elapsed, 1e-9):.3g}"
+            )
+            print(hof.render(options, dataset.variable_names))
+
+        # stop conditions (reference: /root/reference/src/SearchUtils.jl:190-212)
+        if early_stop is not None and any(
+            early_stop(m.loss, m.get_complexity(options))
+            for m in hof.pareto_frontier()
+        ):
+            stop_reason = "early_stop"
+            break
+        if (
+            options.timeout_in_seconds is not None
+            and time.time() - start_time > options.timeout_in_seconds
+        ):
+            stop_reason = "timeout"
+            break
+        if options.max_evals is not None and scorer.num_evals >= options.max_evals:
+            stop_reason = "max_evals"
+            break
+
+    result = SearchResult(
+        hall_of_fame=hof,
+        populations=pops,
+        dataset=dataset,
+        options=options,
+        num_evals=scorer.num_evals,
+    )
+    result.stop_reason = stop_reason
+    return result
+
+
+def equation_search(
+    X,
+    y,
+    *,
+    weights=None,
+    options: Options | None = None,
+    niterations: int = 10,
+    variable_names: list[str] | None = None,
+    y_variable_names=None,
+    saved_state=None,
+    return_state: bool | None = None,
+    verbosity: int | None = None,
+    parallelism: str = "lockstep",
+    X_units=None,
+    y_units=None,
+) -> Any:
+    """Top-level API, mirroring the reference's
+    ``equation_search(X, y; kws...)`` (/root/reference/src/SymbolicRegression.jl:360-428).
+
+    X: (n_features, n). y: (n,) or (n_outputs, n) — multi-output runs one
+    independent search per output row (reference: construct_datasets,
+    /root/reference/src/SearchUtils.jl:472-511). Returns SearchResult, or a
+    list of SearchResult for multi-output.
+    """
+    options = options or Options()
+    X = np.asarray(X)
+    y = np.asarray(y)
+    multi_output = y.ndim == 2
+    ys = y if multi_output else y[None, :]
+    nout = ys.shape[0]
+    if weights is not None:
+        weights = np.asarray(weights)
+        ws = weights if weights.ndim == 2 else weights[None, :]
+    else:
+        ws = [None] * nout
+
+    verbosity = 1 if verbosity is None else verbosity
+    rng = np.random.default_rng(options.seed)
+
+    saved = saved_state
+    if saved is not None and not isinstance(saved, (list, tuple)):
+        saved = [saved]
+
+    results = []
+    for j in range(nout):
+        dataset = Dataset(
+            X,
+            ys[j],
+            weights=ws[j] if weights is not None else None,
+            variable_names=variable_names,
+            X_units=X_units,
+            y_units=y_units[j] if isinstance(y_units, (list, tuple)) else y_units,
+        )
+        output_file = None
+        if options.save_to_file:
+            base = options.output_file or f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"
+            output_file = base if nout == 1 else f"{base}.out{j + 1}"
+        results.append(
+            _search_one_output(
+                dataset,
+                options,
+                niterations,
+                rng,
+                saved_state=saved[j] if saved is not None else None,
+                verbosity=verbosity,
+                output_file=output_file,
+            )
+        )
+    return results if multi_output else results[0]

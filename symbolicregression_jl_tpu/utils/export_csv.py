@@ -1,0 +1,25 @@
+"""Hall-of-fame CSV output with crash-safe double write.
+
+Reference: save_to_file (/root/reference/src/SearchUtils.jl:410-450) —
+``Complexity,Loss,Equation`` rows of the current Pareto frontier, written to a
+``.bkup`` file first then atomically promoted.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["save_hall_of_fame"]
+
+
+def save_hall_of_fame(path: str, hof, options, variable_names=None) -> None:
+    rows = hof.format(options, variable_names)
+    lines = ["Complexity,Loss,Equation"]
+    for r in rows:
+        eq = r["equation"].replace('"', '""')
+        lines.append(f'{r["complexity"]},{r["loss"]:.16g},"{eq}"')
+    content = "\n".join(lines) + "\n"
+    bkup = path + ".bkup"
+    with open(bkup, "w") as f:
+        f.write(content)
+    os.replace(bkup, path)

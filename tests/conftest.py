@@ -8,10 +8,23 @@ import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # override: the shell pre-sets the TPU platform
 prev = os.environ.get("XLA_FLAGS", "")
+extra = []
 if "xla_force_host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (
-        prev + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    extra.append("--xla_force_host_platform_device_count=8")
+if "xla_cpu_enable_fast_math" not in prev:
+    # Expression evaluation produces denormals in discarded switch branches;
+    # x86 denormal assists cause ~100x slowdowns. Fast-math with NaN/Inf/div
+    # honored flushes denormals while preserving the safe-operator semantics
+    # (TPU hardware flushes denormals natively, so this is CPU-test-only).
+    extra.append(
+        "--xla_cpu_enable_fast_math=true"
+        " --xla_cpu_fast_math_honor_nans=true"
+        " --xla_cpu_fast_math_honor_infs=true"
+        " --xla_cpu_fast_math_honor_division=true"
+        " --xla_cpu_fast_math_honor_functions=true"
+    )
+if extra:
+    os.environ["XLA_FLAGS"] = (prev + " " + " ".join(extra)).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,86 @@
+import numpy as np
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.complexity import compute_complexity
+from symbolicregression_jl_tpu.constraints import check_constraints
+from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+OPTS = Options(
+    binary_operators=["+", "*", "pow"],
+    unary_operators=["cos", "exp"],
+    maxsize=10,
+    save_to_file=False,
+)
+
+
+def _chain(depth):
+    t = feature(0)
+    for _ in range(depth):
+        t = unary(0, t)  # cos
+    return t
+
+
+def test_maxsize():
+    t = _chain(9)  # 10 nodes
+    assert check_constraints(t, OPTS)
+    assert not check_constraints(_chain(10), OPTS)
+    # curmaxsize tighter than options.maxsize
+    assert not check_constraints(t, OPTS, maxsize=5)
+
+
+def test_maxdepth():
+    o = Options(
+        binary_operators=["+"], unary_operators=["cos"], maxsize=30, maxdepth=3,
+        save_to_file=False,
+    )
+    assert check_constraints(_chain(2), o)
+    assert not check_constraints(_chain(3), o)
+
+
+def test_operator_size_constraints():
+    # pow's exponent subtree limited to 1 node (reference constraints form)
+    o = Options(
+        binary_operators=["+", "*", "pow"],
+        unary_operators=[],
+        maxsize=20,
+        constraints={"pow": (-1, 1)},
+        save_to_file=False,
+    )
+    pw = o.operators.binary_index("pow")
+    pl = o.operators.binary_index("+")
+    ok = binary(pw, binary(pl, feature(0), feature(1)), constant(2.0))
+    assert check_constraints(ok, o)
+    bad = binary(pw, feature(0), binary(pl, feature(1), constant(1.0)))
+    assert not check_constraints(bad, o)
+
+
+def test_nested_constraints():
+    # cos may not appear inside cos
+    o = Options(
+        binary_operators=["+"],
+        unary_operators=["cos", "exp"],
+        maxsize=20,
+        nested_constraints={"cos": {"cos": 0}},
+        save_to_file=False,
+    )
+    c = o.operators.unary_index("cos")
+    e = o.operators.unary_index("exp")
+    assert check_constraints(unary(c, unary(e, feature(0))), o)
+    assert not check_constraints(unary(c, unary(e, unary(c, feature(0)))), o)
+
+
+def test_custom_complexity():
+    o = Options(
+        binary_operators=["+", "*"],
+        unary_operators=["exp"],
+        complexity_of_operators={"exp": 3, "*": 2},
+        complexity_of_constants=0.5,
+        save_to_file=False,
+    )
+    t = binary(
+        o.operators.binary_index("*"),
+        unary(0, feature(0)),
+        constant(1.0),
+    )
+    # * (2) + exp (3) + x (1) + const (0.5) = 6.5 -> round 6
+    assert compute_complexity(t, o) == 6

@@ -1,0 +1,50 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from symbolicregression_jl_tpu.ops.losses import (
+    LOSSES,
+    HuberLoss,
+    L2DistLoss,
+    QuantileLoss,
+    resolve_loss,
+    weighted_mean_loss,
+)
+
+
+def test_l2_default():
+    assert resolve_loss(None) is L2DistLoss
+    p = jnp.array([1.0, 2.0])
+    t = jnp.array([0.0, 0.0])
+    np.testing.assert_allclose(L2DistLoss(p, t), [1.0, 4.0])
+
+
+def test_resolve_by_name_and_param():
+    h = resolve_loss("HuberLoss(2.0)")
+    a = np.asarray(h(jnp.array([5.0]), jnp.array([0.0])))
+    # |d|=5 > 2: 2*(5-1) = 8
+    np.testing.assert_allclose(a, [8.0])
+    q = resolve_loss("QuantileLoss(0.9)")
+    np.testing.assert_allclose(np.asarray(q(jnp.array([0.0]), jnp.array([1.0]))), [0.9])
+
+
+def test_unknown_loss():
+    with pytest.raises(KeyError):
+        resolve_loss("NopeLoss")
+
+
+def test_all_losses_finite_on_normal_input():
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.normal(size=32).astype(np.float32))
+    t = jnp.asarray(np.sign(rng.normal(size=32)).astype(np.float32))
+    for name, fn in LOSSES.items():
+        out = np.asarray(fn(p, t))
+        assert out.shape == (32,), name
+        assert np.all(np.isfinite(out)), name
+
+
+def test_weighted_mean():
+    elem = jnp.array([[1.0, 3.0]])
+    w = jnp.array([[1.0, 3.0]])
+    np.testing.assert_allclose(weighted_mean_loss(elem, w), [2.5])
+    np.testing.assert_allclose(weighted_mean_loss(elem), [2.0])

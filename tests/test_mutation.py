@@ -1,0 +1,148 @@
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.models import mutation_functions as mf
+from symbolicregression_jl_tpu.models.mutate import condition_mutation_weights, propose_mutation
+from symbolicregression_jl_tpu.models.pop_member import PopMember
+from symbolicregression_jl_tpu.models.simplify import combine_operators, simplify_tree
+from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/"],
+    unary_operators=["cos", "exp"],
+    maxsize=15,
+    save_to_file=False,
+)
+OPS = OPTS.operators
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def test_gen_random_tree_fixed_size(rng):
+    for size in [1, 3, 5, 8, 13]:
+        t = mf.gen_random_tree_fixed_size(size, OPS, 3, rng)
+        assert t.count_nodes() == size
+
+
+def test_mutate_constant_changes_value(rng):
+    t = binary(0, constant(1.0), feature(0))
+    before = t.l.val
+    for _ in range(10):
+        mf.mutate_constant(t, 1.0, OPTS, rng)
+    assert t.l.val != before
+
+
+def test_swap_operands(rng):
+    t = binary(OPS.binary_index("-"), feature(0), feature(1))
+    mf.swap_operands(t, rng)
+    assert t.l.feat == 1 and t.r.feat == 0
+
+
+def test_delete_random_op_shrinks(rng):
+    for _ in range(20):
+        t = mf.gen_random_tree_fixed_size(9, OPS, 3, rng)
+        n0 = t.count_nodes()
+        t2 = mf.delete_random_op(t, OPS, 3, rng)
+        assert t2.count_nodes() <= n0
+
+
+def test_crossover_preserves_total_count_distribution(rng):
+    a = mf.gen_random_tree_fixed_size(9, OPS, 3, rng)
+    b = mf.gen_random_tree_fixed_size(5, OPS, 3, rng)
+    na, nb = a.count_nodes(), b.count_nodes()
+    c1, c2 = mf.crossover_trees(a, b, rng)
+    # subtree swap preserves the total node count across the pair
+    assert c1.count_nodes() + c2.count_nodes() == na + nb
+    # parents untouched
+    assert a.count_nodes() == na and b.count_nodes() == nb
+
+
+def test_condition_weights_leaf_tree():
+    m = PopMember(feature(0), 1.0, 1.0, complexity=1)
+    w = condition_mutation_weights(m, OPTS, curmaxsize=15)
+    names = OPTS.mutation_weights.NAMES
+    idx = {n: i for i, n in enumerate(names)}
+    assert w[idx["mutate_operator"]] == 0
+    assert w[idx["delete_node"]] == 0
+    assert w[idx["mutate_constant"]] == 0  # not a constant leaf
+    assert w[idx["add_node"]] > 0
+
+
+def test_condition_weights_at_maxsize():
+    t = mf.gen_random_tree_fixed_size(15, OPS, 3, np.random.default_rng(0))
+    m = PopMember(t, 1.0, 1.0)
+    w = condition_mutation_weights(m, OPTS, curmaxsize=10)
+    idx = {n: i for i, n in enumerate(OPTS.mutation_weights.NAMES)}
+    assert w[idx["add_node"]] == 0
+    assert w[idx["insert_node"]] == 0
+
+
+def test_propose_respects_constraints(rng):
+    t = mf.gen_random_tree_fixed_size(10, OPS, 3, rng)
+    m = PopMember(t, 1.0, 1.0)
+    for _ in range(50):
+        prop = propose_mutation(m, 1.0, 12, OPTS, 3, rng)
+        if prop.tree is not None and not prop.failed and prop.kind != "do_nothing":
+            from symbolicregression_jl_tpu.constraints import check_constraints
+
+            assert check_constraints(prop.tree, OPTS, 12)
+
+
+def test_simplify_constant_folding():
+    # (1 + 2) * x -> 3 * x
+    t = binary(
+        OPS.binary_index("*"),
+        binary(OPS.binary_index("+"), constant(1.0), constant(2.0)),
+        feature(0),
+    )
+    s = simplify_tree(t, OPTS)
+    assert s.l.is_const and s.l.val == 3.0
+
+
+def test_combine_operators_add_chain():
+    # 1 + (x + 2) -> (3 + x) or (x + 3)
+    t = binary(
+        OPS.binary_index("+"),
+        constant(1.0),
+        binary(OPS.binary_index("+"), feature(0), constant(2.0)),
+    )
+    c = combine_operators(t, OPTS)
+    consts = [n.val for n in c if n.degree == 0 and n.is_const]
+    assert consts == [3.0]
+    assert c.count_nodes() == 3
+
+
+def test_combine_operators_sub_chain():
+    # (x - 1) - 2 -> x - 3
+    t = binary(
+        OPS.binary_index("-"),
+        binary(OPS.binary_index("-"), feature(0), constant(1.0)),
+        constant(2.0),
+    )
+    c = combine_operators(t, OPTS)
+    assert c.count_nodes() == 3
+    assert c.r.is_const and c.r.val == 3.0
+
+
+def test_simplify_preserves_semantics(rng):
+    X = rng.normal(size=(3, 20)).astype(np.float64)
+    Xp = X * (1 + 1e-5)
+    for _ in range(30):
+        t = mf.gen_random_tree_fixed_size(11, OPS, 3, rng)
+        want = t.eval_np(X, OPS)
+        s = combine_operators(simplify_tree(t.copy(), OPTS), OPTS)
+        got = s.eval_np(X, OPS)
+        both_nan = np.isnan(want) & np.isnan(got)
+        # folding runs true f64 on host while the jnp oracle computes f32
+        # (x64 disabled): allow f32-level differences, scaled by a
+        # perturbation-based conditioning estimate (divisions near poles
+        # amplify representation-level differences arbitrarily).
+        sens = np.abs(t.eval_np(Xp, OPS) - want)
+        sens = np.where(np.isfinite(sens), sens, np.inf)
+        tol = np.maximum(1e-6 + 1e-4 * np.abs(want), 10 * sens)
+        ok = (np.abs(want - got) <= tol) | both_nan | ~np.isfinite(want)
+        assert np.all(ok), (t.string_tree(OPS), s.string_tree(OPS))

@@ -1,0 +1,152 @@
+"""Integration tests: planted-equation recovery (the reference's contract-test
+strategy, test/test_mixed.jl) on small budgets, CPU."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+
+
+def small_options(**kw):
+    defaults = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=[],
+        populations=6,
+        population_size=20,
+        ncycles_per_iteration=40,
+        maxsize=12,
+        seed=0,
+        save_to_file=False,
+    )
+    defaults.update(kw)
+    return Options(**defaults)
+
+
+def test_recover_linear():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3, 80)).astype(np.float32)
+    y = 2.0 * X[0] + X[1]
+    res = equation_search(X, y, options=small_options(), niterations=6, verbosity=0)
+    assert res.best().loss < 1e-4
+    # re-evaluate best tree on fresh data (reference asserts re-evaluation too)
+    X2 = rng.normal(size=(3, 50)).astype(np.float32)
+    pred = res.best().tree.eval_np(X2, res.options.operators)
+    np.testing.assert_allclose(pred, 2.0 * X2[0] + X2[1], atol=2e-2, rtol=1e-2)
+
+
+def test_recover_quadratic_with_constant():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2, 100)).astype(np.float32)
+    y = X[0] * X[0] - 1.5
+    res = equation_search(
+        X,
+        y,
+        options=small_options(ncycles_per_iteration=60),
+        niterations=8,
+        verbosity=0,
+    )
+    assert res.best().loss < 1e-3
+
+
+def test_multioutput():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2, 60)).astype(np.float32)
+    Y = np.stack([X[0] + X[1], X[0] * X[1]])
+    results = equation_search(
+        X, Y, options=small_options(ncycles_per_iteration=25), niterations=4, verbosity=0
+    )
+    assert len(results) == 2
+    assert results[0].best().loss < 1e-3
+
+
+def test_weighted():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2, 80)).astype(np.float32)
+    y = X[0] - X[1]
+    w = np.abs(rng.normal(size=80)).astype(np.float32) + 0.1
+    res = equation_search(
+        X, y, weights=w, options=small_options(ncycles_per_iteration=25), niterations=4, verbosity=0
+    )
+    assert res.best().loss < 1e-3
+
+
+def test_early_stop():
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(2, 60)).astype(np.float32)
+    y = X[0]
+    res = equation_search(
+        X,
+        y,
+        options=small_options(early_stop_condition=1e-6),
+        niterations=20,
+        verbosity=0,
+    )
+    assert res.stop_reason == "early_stop"
+    assert res.best().loss < 1e-6
+
+
+def test_max_evals_stop():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(2, 60)).astype(np.float32)
+    y = X[0] * X[1] + X[0]
+    res = equation_search(
+        X, y, options=small_options(max_evals=2000), niterations=50, verbosity=0
+    )
+    assert res.stop_reason == "max_evals"
+    assert res.num_evals < 6000
+
+
+def test_warm_start_resume():
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(2, 80)).astype(np.float32)
+    y = X[0] * X[0] + X[1]
+    opts = small_options(ncycles_per_iteration=30)
+    res1 = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    loss1 = res1.best().loss
+    res2 = equation_search(
+        X, y, options=opts, niterations=3, verbosity=0, saved_state=res1
+    )
+    assert res2.best().loss <= loss1 * 1.5 + 1e-12  # no catastrophic regression
+
+
+def test_determinism():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2, 60)).astype(np.float32)
+    y = X[0] + 2 * X[1]
+    opts = dict(ncycles_per_iteration=20, deterministic=True, seed=123)
+    r1 = equation_search(X, y, options=small_options(**opts), niterations=3, verbosity=0)
+    r2 = equation_search(X, y, options=small_options(**opts), niterations=3, verbosity=0)
+    b1, b2 = r1.best(), r2.best()
+    assert b1.tree.same_structure(b2.tree)
+    assert b1.loss == b2.loss
+
+
+def test_batching_mode():
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(2, 500)).astype(np.float32)
+    y = X[0] * X[1]
+    res = equation_search(
+        X,
+        y,
+        options=small_options(batching=True, batch_size=32, ncycles_per_iteration=30),
+        niterations=5,
+        verbosity=0,
+    )
+    assert res.best().loss < 1e-2
+
+
+def test_csv_output(tmp_path):
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(2, 50)).astype(np.float32)
+    y = X[0]
+    out = str(tmp_path / "hof.csv")
+    equation_search(
+        X,
+        y,
+        options=small_options(output_file=out, save_to_file=True, ncycles_per_iteration=10),
+        niterations=2,
+        verbosity=0,
+    )
+    content = open(out).read()
+    assert content.startswith("Complexity,Loss,Equation")
+    assert len(content.splitlines()) >= 2
