@@ -1,0 +1,88 @@
+"""Benchmark: eval_loss throughput at the north-star config (BASELINE.md).
+
+Measures sustained batched-scoring throughput — flatten on host, dispatch,
+loss readback — at the reference benchmark's scaled config: 10k-row dataset,
+population 100 islands x 100 members (10k candidate trees per sweep),
+maxsize 20-class trees, ops (+,-,*,/,cos,exp,abs).
+
+One tree-eval = one expression evaluated over ALL dataset rows + reduced to a
+loss (the unit the reference's "expressions evaluated per second" meter counts,
+/root/reference/src/SearchUtils.jl:299-307 — batched evals there count
+fractionally; here every eval is full-data).
+
+vs_baseline: the reference publishes no absolute numbers (BASELINE.md), so the
+denominator is a documented engineering estimate of the reference's
+:multithreading full-data eval throughput at 10k rows on a 16-core host:
+~2.5e4 tree-evals/s (DynamicExpressions turbo eval ~200us/tree/10k rows/core
+x 8 effective threads). The driver target is >=20x, i.e. vs_baseline >= 20.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REF_EVALS_PER_SEC_ESTIMATE = 2.5e4
+
+N_ROWS = 10_000
+N_TREES = 10_000
+CHUNK = 2_048  # trees per device dispatch (power-of-two bucket)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.scoring import batched_loss_jit
+
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        maxsize=20,
+        save_to_file=False,
+    )
+    opset, loss_elem = options.operators, options.loss
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, N_ROWS)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+
+    trees = Population.random_trees(N_TREES, options, 5, rng)
+    chunks = [trees[i : i + CHUNK] for i in range(0, N_TREES, CHUNK)]
+
+    # warmup (compile)
+    flat0 = flatten_trees(chunks[0] + chunks[0][: CHUNK - len(chunks[0])], options.max_nodes)
+    batched_loss_jit(flat0, Xd, yd, None, opset, loss_elem).block_until_ready()
+
+    # timed: full host->device->host loop incl. flatten (the real search path)
+    t0 = time.time()
+    outs = []
+    for c in chunks:
+        flat = flatten_trees(c + c[: CHUNK - len(c)], options.max_nodes)
+        outs.append(batched_loss_jit(flat, Xd, yd, None, opset, loss_elem))
+    total = float(sum(np.asarray(o)[: len(c)].sum() for o, c in zip(outs, chunks)))
+    dt = time.time() - t0
+    evals_per_sec = N_TREES / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "eval_loss_throughput",
+                "value": round(evals_per_sec, 1),
+                "unit": "tree-evals/s/chip (10k rows/eval, pop=10k trees)",
+                "vs_baseline": round(evals_per_sec / REF_EVALS_PER_SEC_ESTIMATE, 2),
+            }
+        )
+    )
+    return total  # keep the reduction live
+
+
+if __name__ == "__main__":
+    main()

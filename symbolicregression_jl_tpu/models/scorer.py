@@ -35,10 +35,45 @@ class BatchScorer:
         self.max_nodes = options.max_nodes
         X, y, w = dataset.device_arrays(self.dtype)
         self.X, self.y, self.w = X, y, w
+        self._sharded = None
+        if options.data_sharding == "rows":
+            self._setup_row_sharding()
         bl, use = baseline_loss(dataset, self.opset, self.loss_elem, self.dtype)
         dataset.baseline_loss = bl
         dataset.use_baseline = use
         self.num_evals = 0.0
+
+    def _setup_row_sharding(self) -> None:
+        """Shard the dataset rows across all devices and route full-data
+        scoring through the psum loss (SURVEY.md §5.7: the 'long axis' is the
+        dataset-row axis; only scalar loss partials cross chips)."""
+        import warnings
+
+        import jax
+
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharding import make_sharded_loss, shard_dataset
+
+        n_dev = len(jax.devices())
+        if n_dev == 1:
+            return
+        if self.dataset.n % n_dev != 0:
+            warnings.warn(
+                f"data_sharding='rows' needs n ({self.dataset.n}) divisible by "
+                f"device count ({n_dev}); falling back to single-device scoring"
+            )
+            return
+        mesh = make_mesh(1, n_dev)
+        self._mesh = mesh
+        self._sharded = make_sharded_loss(
+            mesh, self.opset, self.loss_elem, has_weights=self.w is not None
+        )
+        self.X, self.y, self.w = shard_dataset(
+            mesh, self.dataset.X.astype(self.dtype),
+            self.dataset.y.astype(self.dtype),
+            None if self.dataset.weights is None
+            else self.dataset.weights.astype(self.dtype),
+        )
 
     # -- losses --------------------------------------------------------------
 
@@ -63,7 +98,16 @@ class BatchScorer:
             y = self.y[idx]
             w = None if self.w is None else self.w[idx]
             self.num_evals += P * (len(idx) / self.dataset.n)
-        dev_losses = batched_loss_jit(flat, X, y, w, self.opset, self.loss_elem)
+        if self._sharded is not None and idx is None:
+            import jax.numpy as jnp
+
+            from ..parallel.sharding import shard_population
+
+            fs = shard_population(self._mesh, flat)
+            w_arg = self.w if self.w is not None else jnp.zeros((), self.dtype)
+            dev_losses = self._sharded(fs, self.X, self.y, w_arg)
+        else:
+            dev_losses = batched_loss_jit(flat, X, y, w, self.opset, self.loss_elem)
         try:
             dev_losses.copy_to_host_async()
         except Exception:

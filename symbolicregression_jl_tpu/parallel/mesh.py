@@ -1,0 +1,47 @@
+"""Device-mesh helpers.
+
+The framework's two parallel axes (SURVEY.md §2.2-2.3, §5.7-5.8):
+  - ``pop``:  island/population axis — embarrassingly parallel tree batches
+              (the reference's multithreading/multiprocessing axis),
+  - ``rows``: dataset-row axis — data-parallel loss reduction over ICI
+              (the reference's minibatch/SIMD axis, scaled out).
+
+Multi-host runs extend the same mesh over DCN via jax.distributed: unlike the
+reference's Distributed.jl bootstrap (code shipping, @everywhere —
+/root/reference/src/Configure.jl:309-343), SPMD needs no code movement — every
+host runs the same program on its slice of the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "population_sharding", "data_sharding", "P"]
+
+
+def make_mesh(
+    n_pop: int | None = None,
+    n_rows: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Create a ('pop', 'rows') mesh. Default: all devices on the pop axis."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if n_pop is None:
+        n_pop = n // n_rows
+    if n_pop * n_rows != n:
+        raise ValueError(f"mesh {n_pop}x{n_rows} != {n} devices")
+    arr = np.asarray(devices).reshape(n_pop, n_rows)
+    return Mesh(arr, axis_names=("pop", "rows"))
+
+
+def population_sharding(mesh: Mesh) -> NamedSharding:
+    """FlatTrees arrays [P, N]: shard trees across 'pop', replicate slots."""
+    return NamedSharding(mesh, P("pop", None))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """X [F, R] / y [R]: shard the row axis across 'rows'."""
+    return NamedSharding(mesh, P(None, "rows"))

@@ -1,0 +1,98 @@
+"""Sharded scoring: population-parallel x row-parallel loss over a mesh.
+
+TPU-native replacement for the reference's distributed loss path (SURVEY.md
+§2.3): trees shard across the 'pop' mesh axis, dataset rows shard across the
+'rows' axis, each device evaluates its (tree-shard x row-shard) block, and the
+weighted loss reduction crosses chips as a single ``psum`` over ICI — only the
+scalar partials move, never predictions.
+
+Written with shard_map so the collective is explicit; the XLA-automatic
+(NamedSharding + jit) path works too and is used by the scorer when a mesh is
+configured.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.flat import FlatTrees
+from ..ops.interp import eval_trees
+from ..ops.operators import OperatorSet
+from .mesh import data_sharding, population_sharding
+
+__all__ = ["make_sharded_loss", "shard_dataset", "shard_population"]
+
+
+def make_sharded_loss(
+    mesh: Mesh, opset: OperatorSet, loss_elem: Callable, has_weights: bool = False
+) -> Callable:
+    """Build a jitted loss over the mesh: (flat[P,N], X[F,R], y[R], w[R]?) ->
+    losses[P], with P sharded over 'pop' and R sharded over 'rows'."""
+
+    def per_shard(flat: FlatTrees, X, y, w):
+        # local block: [P/pop_axis trees] x [R/rows_axis rows]
+        preds = eval_trees(flat, X, opset)
+        elem = loss_elem(preds, y[None, :])
+        if has_weights:
+            num = jax.lax.psum(jnp.sum(elem * w[None, :], axis=-1), "rows")
+            den = jax.lax.psum(jnp.sum(w), "rows")
+        else:
+            num = jax.lax.psum(jnp.sum(elem, axis=-1), "rows")
+            den = jax.lax.psum(jnp.asarray(y.shape[0], elem.dtype), "rows")
+        loss = num / den
+        ok = jax.lax.pmin(
+            jnp.isfinite(preds).all(axis=-1).astype(jnp.int32), "rows"
+        )
+        return jnp.where(ok == 1, loss, jnp.inf)
+
+    flat_spec = FlatTrees(
+        kind=P("pop", None),
+        op=P("pop", None),
+        lhs=P("pop", None),
+        rhs=P("pop", None),
+        feat=P("pop", None),
+        val=P("pop", None),
+        length=P("pop"),
+    )
+    w_spec = P("rows") if has_weights else P()
+    mapped = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(flat_spec, P(None, "rows"), P("rows"), w_spec),
+        out_specs=P("pop"),
+        # the interpreter's scan creates its carry inside the mapped fn; VMA
+        # inference flags it as unvarying vs the sharded inputs, so disable
+        # the (conservative) check rather than pvary deep inside the kernel
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def shard_dataset(mesh: Mesh, X, y, weights=None):
+    """Place dataset arrays row-sharded on the mesh (pads rows to the mesh
+    divisor upstream if needed)."""
+    xs = data_sharding(mesh)
+    ys = NamedSharding(mesh, P("rows"))
+    X = jax.device_put(jnp.asarray(X), xs)
+    y = jax.device_put(jnp.asarray(y), ys)
+    w = None if weights is None else jax.device_put(jnp.asarray(weights), ys)
+    return X, y, w
+
+
+def shard_population(mesh: Mesh, flat: FlatTrees) -> FlatTrees:
+    """Place a FlatTrees batch tree-sharded across the 'pop' axis."""
+    row = population_sharding(mesh)
+    vec = NamedSharding(mesh, P("pop"))
+    return FlatTrees(
+        kind=jax.device_put(jnp.asarray(flat.kind), row),
+        op=jax.device_put(jnp.asarray(flat.op), row),
+        lhs=jax.device_put(jnp.asarray(flat.lhs), row),
+        rhs=jax.device_put(jnp.asarray(flat.rhs), row),
+        feat=jax.device_put(jnp.asarray(flat.feat), row),
+        val=jax.device_put(jnp.asarray(flat.val), row),
+        length=jax.device_put(jnp.asarray(flat.length), vec),
+    )

@@ -1,30 +1,34 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-imports, so multi-chip sharding paths are exercised without TPU hardware
-(mirrors how the reference tests :multiprocessing with local workers,
-/root/reference/test/manual_distributed.jl)."""
+"""Test configuration: force an 8-device virtual CPU platform so multi-chip
+sharding paths are exercised without TPU hardware (mirrors how the reference
+tests :multiprocessing with local workers,
+/root/reference/test/manual_distributed.jl).
+
+NOTE: this environment preloads `jax` at interpreter startup (tunnel plugin),
+so env vars set here are too late — but the backend is not yet initialized, so
+`jax.config` updates still take effect. XLA_FLAGS is read at first backend
+init, which also happens after this file runs.
+"""
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # override: the shell pre-sets the TPU platform
 prev = os.environ.get("XLA_FLAGS", "")
-extra = []
-if "xla_force_host_platform_device_count" not in prev:
-    extra.append("--xla_force_host_platform_device_count=8")
 if "xla_cpu_enable_fast_math" not in prev:
     # Expression evaluation produces denormals in discarded switch branches;
     # x86 denormal assists cause ~100x slowdowns. Fast-math with NaN/Inf/div
     # honored flushes denormals while preserving the safe-operator semantics
     # (TPU hardware flushes denormals natively, so this is CPU-test-only).
-    extra.append(
-        "--xla_cpu_enable_fast_math=true"
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_cpu_enable_fast_math=true"
         " --xla_cpu_fast_math_honor_nans=true"
         " --xla_cpu_fast_math_honor_infs=true"
         " --xla_cpu_fast_math_honor_division=true"
         " --xla_cpu_fast_math_honor_functions=true"
-    )
-if extra:
-    os.environ["XLA_FLAGS"] = (prev + " " + " ".join(extra)).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+    ).strip()
+
+import jax  # noqa: E402  (preloaded anyway; config must precede backend init)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

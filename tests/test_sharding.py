@@ -1,0 +1,69 @@
+"""Multi-device sharding tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.models.population import Population
+from symbolicregression_jl_tpu.ops import flatten_trees
+from symbolicregression_jl_tpu.ops.scoring import batched_loss_jit
+from symbolicregression_jl_tpu.parallel.mesh import make_mesh
+from symbolicregression_jl_tpu.parallel.sharding import (
+    make_sharded_loss,
+    shard_dataset,
+    shard_population,
+)
+
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/"],
+    unary_operators=["cos"],
+    maxsize=16,
+    save_to_file=False,
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3, 64)).astype(np.float32)
+    y = (X[0] * X[1] + np.cos(X[2])).astype(np.float32)
+    trees = Population.random_trees(32, OPTS, 3, rng)
+    flat = flatten_trees(trees, OPTS.max_nodes)
+    return X, y, flat
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_loss_matches_single_device(problem, mesh_shape):
+    X, y, flat = problem
+    mesh = make_mesh(*mesh_shape)
+    want = np.asarray(batched_loss_jit(flat, jnp.asarray(X), jnp.asarray(y), None, OPTS.operators, OPTS.loss))
+    loss_fn = make_sharded_loss(mesh, OPTS.operators, OPTS.loss)
+    Xs, ys, _ = shard_dataset(mesh, X, y)
+    fs = shard_population(mesh, flat)
+    got = np.asarray(loss_fn(fs, Xs, ys, jnp.zeros((), jnp.float32)))
+    inf_both = np.isinf(want) & np.isinf(got)
+    np.testing.assert_allclose(
+        got[~inf_both], want[~inf_both], rtol=2e-5, atol=1e-5
+    )
+    assert (np.isinf(got) == np.isinf(want)).all()
+
+
+def test_sharded_loss_weighted(problem):
+    X, y, flat = problem
+    rng = np.random.default_rng(1)
+    w = (np.abs(rng.normal(size=y.shape[0])) + 0.1).astype(np.float32)
+    mesh = make_mesh(4, 2)
+    want = np.asarray(
+        batched_loss_jit(flat, jnp.asarray(X), jnp.asarray(y), jnp.asarray(w), OPTS.operators, OPTS.loss)
+    )
+    loss_fn = make_sharded_loss(mesh, OPTS.operators, OPTS.loss, has_weights=True)
+    Xs, ys, ws = shard_dataset(mesh, X, y, w)
+    got = np.asarray(loss_fn(shard_population(mesh, flat), Xs, ys, ws))
+    m = np.isfinite(want)
+    np.testing.assert_allclose(got[m], want[m], rtol=2e-5, atol=1e-5)
