@@ -38,6 +38,14 @@ class BatchScorer:
         self._sharded = None
         if options.data_sharding == "rows":
             self._setup_row_sharding()
+        # Mosaic kernel forward path: probe once per operator set; falls back
+        # to the scan interpreter off-TPU or for non-lowerable operators.
+        if self._sharded is None:
+            from ..ops.interp_pallas import pallas_supported
+
+            self.use_pallas = pallas_supported(self.opset, dataset.n_features)
+        else:
+            self.use_pallas = False
         bl, use = baseline_loss(dataset, self.opset, self.loss_elem, self.dtype)
         dataset.baseline_loss = bl
         dataset.use_baseline = use
@@ -107,7 +115,9 @@ class BatchScorer:
             w_arg = self.w if self.w is not None else jnp.zeros((), self.dtype)
             dev_losses = self._sharded(fs, self.X, self.y, w_arg)
         else:
-            dev_losses = batched_loss_jit(flat, X, y, w, self.opset, self.loss_elem)
+            dev_losses = batched_loss_jit(
+                flat, X, y, w, self.opset, self.loss_elem, use_pallas=self.use_pallas
+            )
         try:
             dev_losses.copy_to_host_async()
         except Exception:

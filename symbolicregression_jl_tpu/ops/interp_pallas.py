@@ -1,0 +1,231 @@
+"""Pallas TPU kernel for batched tree evaluation — the fast forward path.
+
+Why a kernel (vs. the lax.scan interpreter in interp.py):
+  1. The scan interpreter's vmapped ``lax.switch`` computes EVERY operator
+     branch for every slot and selects — ~n_ops x wasted VPU work. Here the
+     opcode is a scalar per (tree, slot), so ``lax.switch`` lowers to a real
+     branch and only the needed op executes.
+  2. The SSA value buffer lives in VMEM scratch — zero HBM traffic for
+     intermediates (the scan version round-trips [P, N, R] through HBM).
+  3. The slot loop runs to each tree's actual ``length``, not the padded
+     budget — pad slots cost nothing.
+
+Memory plan: per-tree structure is packed into two lane-aligned HBM arrays —
+ints [P, L] = (kind | op | lhs | rhs | feat | length) and vals [P, Lv] — so
+each program DMAs exactly two (P_TILE, L) row-slices into SMEM scratch
+(dynamic slicing is sublane-dim only, and DMA lane widths must be 128-aligned).
+Scalar memory supports the dynamic per-slot reads the interpreter needs; each
+program evaluates P_TILE trees sequentially over one row tile with the value
+buffer in VMEM [N, R_TILE]. Postorder guarantees each tree overwrites every
+slot it reads, so the buffer is safely reused across trees.
+
+Not every operator lowers through Mosaic; ``pallas_supported`` probes
+compilation once per operator set and scoring falls back to the scan
+interpreter when unsupported.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flat import KIND_CONST, FlatTrees
+from .operators import OperatorSet
+
+__all__ = ["eval_trees_pallas", "pallas_supported"]
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def _make_kernel(opset: OperatorSet, n_slots: int, p_tile: int, r_tile: int):
+    unary_fns = [op.kernel_fn or op.fn for op in opset.unary]
+    binary_fns = [op.kernel_fn or op.fn for op in opset.binary]
+    N = n_slots
+
+    def kernel(ints_hbm, vals_hbm, x_ref, out_ref, ints_s, vals_s, buf_ref, sems):
+        p = pl.program_id(0)
+        start = p * p_tile
+
+        c1 = pltpu.make_async_copy(
+            ints_hbm.at[pl.ds(start, p_tile), :], ints_s, sems.at[0]
+        )
+        c2 = pltpu.make_async_copy(
+            vals_hbm.at[pl.ds(start, p_tile), :], vals_s, sems.at[1]
+        )
+        c1.start()
+        c2.start()
+        c1.wait()
+        c2.wait()
+
+        def tree_body(t, _):
+            length = ints_s[t, 5 * N]
+
+            def slot_body(i, _):
+                k = ints_s[t, i]
+                o = ints_s[t, N + i]
+
+                def const_case():
+                    return jnp.full((1, r_tile), vals_s[t, i], dtype=jnp.float32)
+
+                def var_case():
+                    return x_ref[pl.ds(ints_s[t, 4 * N + i], 1), :]
+
+                def unary_case():
+                    l = buf_ref[pl.ds(ints_s[t, 2 * N + i], 1), :]
+                    if len(unary_fns) == 0:
+                        return l
+                    if len(unary_fns) == 1:
+                        return unary_fns[0](l)
+                    return lax.switch(o, unary_fns, l)
+
+                def binary_case():
+                    l = buf_ref[pl.ds(ints_s[t, 2 * N + i], 1), :]
+                    r = buf_ref[pl.ds(ints_s[t, 3 * N + i], 1), :]
+                    if len(binary_fns) == 0:
+                        return l
+                    if len(binary_fns) == 1:
+                        return binary_fns[0](l, r)
+                    return lax.switch(o, binary_fns, l, r)
+
+                res = lax.switch(
+                    jnp.clip(k - KIND_CONST, 0, 3),
+                    [const_case, var_case, unary_case, binary_case],
+                )
+                buf_ref[pl.ds(i, 1), :] = res
+                return 0
+
+            lax.fori_loop(0, length, slot_body, 0)
+            out_ref[pl.ds(t, 1), :] = buf_ref[pl.ds(length - 1, 1), :]
+            return 0
+
+        lax.fori_loop(0, p_tile, tree_body, 0)
+
+    # distinct name per specialization: executable caches keyed on the kernel
+    # name must not collide across (N, p_tile, r_tile, opset) variants
+    kernel.__name__ = (
+        f"sr_eval_n{n_slots}_p{p_tile}_r{r_tile}_h{hash(opset) & 0xFFFFFFFF:x}"
+    )
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("opset", "n_slots", "p_tile", "r_tile")
+)
+def _eval_pallas(ints, vals, X, opset, n_slots, p_tile, r_tile):
+    P, L = ints.shape
+    Lv = vals.shape[1]
+    F, R_padded = X.shape
+    n_r_tiles = R_padded // r_tile
+    kernel = _make_kernel(opset, n_slots, p_tile, r_tile)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((P, R_padded), jnp.float32),
+        grid=(P // p_tile, n_r_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # ints (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),  # vals (HBM)
+            pl.BlockSpec((F, r_tile), lambda p, r: (0, r), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (p_tile, r_tile), lambda p, r: (p, r), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.SMEM((p_tile, L), jnp.int32),
+            pltpu.SMEM((p_tile, Lv), jnp.float32),
+            pltpu.VMEM((n_slots, r_tile), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+    )(ints, vals, X)
+
+
+def pack_flat(flat: FlatTrees):
+    """Pack FlatTrees into the kernel's two lane-aligned arrays.
+    ints [P, L]: kind | op | lhs | rhs | feat | length (L = roundup(5N+1, 128));
+    vals [P, Lv] (Lv = roundup(N, 128))."""
+    P, N = flat.kind.shape
+    L = _round_up(5 * N + 1, 128)
+    Lv = _round_up(N, 128)
+    ints = jnp.concatenate(
+        [
+            jnp.asarray(flat.kind, jnp.int32),
+            jnp.asarray(flat.op, jnp.int32),
+            jnp.asarray(flat.lhs, jnp.int32),
+            jnp.asarray(flat.rhs, jnp.int32),
+            jnp.asarray(flat.feat, jnp.int32),
+            jnp.asarray(flat.length, jnp.int32)[:, None],
+        ],
+        axis=1,
+    )
+    ints = jnp.pad(ints, ((0, 0), (0, L - ints.shape[1])))
+    vals = jnp.pad(
+        jnp.asarray(flat.val, jnp.float32), ((0, 0), (0, Lv - N))
+    )
+    return ints, vals
+
+
+def eval_trees_pallas(
+    flat: FlatTrees, X, opset: OperatorSet, r_tile: int = 1024, p_tile: int = 8
+) -> jax.Array:
+    """preds [P, R] via the Pallas kernel. X: [F, R] float32.
+
+    NOTE: r_tile is intentionally FIXED at its default for all callers — this
+    backend aborts when kernels with different lane widths run in the same
+    process (observed empirically: a 128-lane probe followed by a 1024-lane
+    call -> ABORTED). Small row counts are padded up to one full tile instead.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    P, N = flat.kind.shape
+    F, R = X.shape
+    R_padded = _round_up(R, r_tile)
+    if R_padded != R:
+        X = jnp.pad(X, ((0, 0), (0, R_padded - R)), constant_values=1.0)
+    if P % p_tile != 0:
+        raise ValueError(f"P={P} must be a multiple of p_tile={p_tile}")
+    ints, vals = pack_flat(flat)
+    preds = _eval_pallas(ints, vals, X, opset, N, p_tile, r_tile)
+    return preds[:, :R]
+
+
+_SUPPORT_CACHE: dict = {}
+
+
+def pallas_supported(opset: OperatorSet, n_features: int = 2) -> bool:
+    """Probe whether this operator set lowers through Mosaic (cached)."""
+    if jax.devices()[0].platform not in ("tpu",):
+        return False
+    if opset in _SUPPORT_CACHE:
+        return _SUPPORT_CACHE[opset]
+    try:
+        from .flat import flatten_trees
+        from ..tree import binary, constant, feature, unary as unary_node
+
+        # a probe batch touching every operator
+        t = constant(1.0)
+        for i in range(opset.n_binary):
+            t = binary(i, t, feature(0))
+        for i in range(opset.n_unary):
+            t = unary_node(i, t)
+        n_nodes = 1 + 2 * opset.n_binary + opset.n_unary
+        flat = flatten_trees([t] * 8, _round_up(n_nodes, 8))
+        X = np.ones((max(n_features, 1), 128), np.float32)
+        out = eval_trees_pallas(flat, X, opset)
+        out.block_until_ready()
+        _SUPPORT_CACHE[opset] = True
+    except Exception as e:  # noqa: BLE001 — any lowering failure means fallback
+        import warnings
+
+        warnings.warn(f"Pallas eval unavailable for {opset}: {type(e).__name__}: {e}")
+        _SUPPORT_CACHE[opset] = False
+    return _SUPPORT_CACHE[opset]

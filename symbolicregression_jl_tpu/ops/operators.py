@@ -40,12 +40,16 @@ class Operator:
       arity: 1 or 2.
       fn: the JAX implementation (elementwise, NaN-guarded).
       display: infix symbol for binary operators (None -> function-call form).
+      kernel_fn: optional Mosaic-safe variant used inside the Pallas kernel —
+        some ops (pow, erf, gamma, inverse-hyperbolics) use primitives that
+        don't lower through Mosaic; these float-only reformulations do.
     """
 
     name: str
     arity: int
     fn: Callable[..., jax.Array]
     display: str | None = None
+    kernel_fn: Callable[..., jax.Array] | None = None
 
     def __call__(self, *args):
         return self.fn(*args)
@@ -237,12 +241,114 @@ def min_op(x, y):
     return jnp.minimum(x, y)
 
 
-def _u(name, fn, display=None):
-    return Operator(name=name, arity=1, fn=fn, display=display)
+# ---------------------------------------------------------------------------
+# Mosaic-safe kernel variants (float-only arithmetic; no int casts, no
+# special-function primitives). Accuracy is f32-appropriate.
+# ---------------------------------------------------------------------------
 
 
-def _b(name, fn, display=None):
-    return Operator(name=name, arity=2, fn=fn, display=display)
+def k_safe_pow(x, y):
+    """safe_pow using exp/log and float parity arithmetic only."""
+    yi = jnp.floor(y + 0.5)
+    y_is_int = y == yi
+    invalid = jnp.where(
+        y_is_int,
+        (yi < 0) & (x == 0),
+        jnp.where(y > 0, x < 0, x <= 0),
+    )
+    ax = jnp.abs(x)
+    ax_safe = jnp.where(invalid | (ax == 0), 1.0, ax)
+    mag = jnp.exp(y * jnp.log(ax_safe))
+    mag = jnp.where(ax == 0, jnp.where(y == 0, 1.0, 0.0), mag)
+    half = yi * 0.5
+    odd = (half - jnp.floor(half)) != 0.0
+    signed = jnp.where((x < 0) & odd, -mag, mag)
+    return jnp.where(invalid, jnp.nan, signed)
+
+
+def k_erf(x):
+    """Abramowitz & Stegun 7.1.26 rational approximation (|err| < 1.5e-7)."""
+    s = jnp.sign(x)
+    a = jnp.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * a)
+    poly = t * (
+        0.254829592
+        + t * (-0.284496736 + t * (1.421413741 + t * (-1.453152027 + t * 1.061405429)))
+    )
+    return s * (1.0 - poly * jnp.exp(-a * a))
+
+
+def k_erfc(x):
+    return 1.0 - k_erf(x)
+
+
+def k_asinh(x):
+    a = jnp.abs(x)
+    return jnp.sign(x) * jnp.log(a + jnp.sqrt(a * a + 1.0))
+
+
+def k_acosh(x):
+    bad = x < 1
+    xs = jnp.where(bad, 1.0, x)
+    return jnp.where(bad, jnp.nan, jnp.log(xs + jnp.sqrt(xs * xs - 1.0)))
+
+
+def k_atanh(x):
+    bad = jnp.abs(x) >= 1
+    xs = jnp.where(bad, 0.0, x)
+    return jnp.where(bad, jnp.nan, 0.5 * jnp.log((1.0 + xs) / (1.0 - xs)))
+
+
+def k_atanh_clip(x):
+    wrapped = x + 1.0
+    wrapped = wrapped - 2.0 * jnp.floor(wrapped * 0.5)
+    return k_atanh(wrapped - 1.0)
+
+
+_LANCZOS_G = 7.0
+_LANCZOS = (
+    0.99999999999980993,
+    676.5203681218851,
+    -1259.1392167224028,
+    771.32342877765313,
+    -176.61502916214059,
+    12.507343278686905,
+    -0.13857109526572012,
+    9.9843695780195716e-6,
+    1.5056327351493116e-7,
+)
+
+
+def k_gamma(x):
+    """Lanczos approximation with reflection; Inf/poles -> NaN."""
+    neg = x < 0.5
+    xr = jnp.where(neg, 1.0 - x, x)  # >= 0.5
+    z = xr - 1.0
+    series = _LANCZOS[0]
+    for i, c in enumerate(_LANCZOS[1:]):
+        series = series + c / (z + (i + 1.0))
+    t = z + _LANCZOS_G + 0.5
+    g = jnp.sqrt(2.0 * jnp.pi) * jnp.exp((z + 0.5) * jnp.log(t) - t) * series
+    sin_pix = jnp.sin(jnp.pi * x)
+    refl = jnp.pi / (sin_pix * g)
+    out = jnp.where(neg, refl, g)
+    is_pole = (x == jnp.floor(x)) & (x <= 0)
+    out = jnp.where(is_pole, jnp.nan, out)
+    return jnp.where(jnp.isfinite(out), out, jnp.nan)
+
+
+def k_round(x):
+    """Round-half-away-from-zero via floor (jnp.round's bankers' rounding
+    differs at exact halves — acceptable for kernel use, documented)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+def _u(name, fn, display=None, kernel_fn=None):
+    return Operator(name=name, arity=1, fn=fn, display=display, kernel_fn=kernel_fn)
+
+
+def _b(name, fn, display=None, kernel_fn=None):
+    return Operator(name=name, arity=2, fn=fn, display=display, kernel_fn=kernel_fn)
 
 
 UNARY_OPS: dict[str, Operator] = {
@@ -267,15 +373,15 @@ UNARY_OPS: dict[str, Operator] = {
         _u("asin", safe_asin),
         _u("acos", safe_acos),
         _u("atan", jnp.arctan),
-        _u("asinh", jnp.arcsinh),
-        _u("acosh", safe_acosh),
-        _u("atanh", safe_atanh),
-        _u("atanh_clip", atanh_clip),
-        _u("erf", jax.scipy.special.erf),
-        _u("erfc", jax.scipy.special.erfc),
-        _u("gamma", gamma_full),
+        _u("asinh", jnp.arcsinh, kernel_fn=k_asinh),
+        _u("acosh", safe_acosh, kernel_fn=k_acosh),
+        _u("atanh", safe_atanh, kernel_fn=k_atanh),
+        _u("atanh_clip", atanh_clip, kernel_fn=k_atanh_clip),
+        _u("erf", jax.scipy.special.erf, kernel_fn=k_erf),
+        _u("erfc", jax.scipy.special.erfc, kernel_fn=k_erfc),
+        _u("gamma", gamma_full, kernel_fn=k_gamma),
         _u("relu", relu),
-        _u("round", jnp.round),
+        _u("round", jnp.round, kernel_fn=k_round),
         _u("floor", jnp.floor),
         _u("ceil", jnp.ceil),
         _u("sign", sign_op),
@@ -289,7 +395,7 @@ BINARY_OPS: dict[str, Operator] = {
         _b("sub", sub, "-"),
         _b("mult", mult, "*"),
         _b("div", div, "/"),
-        _b("pow", safe_pow, "^"),
+        _b("pow", safe_pow, "^", kernel_fn=k_safe_pow),
         _b("mod", mod_op),
         _b("greater", greater),
         _b("cond", cond_op),

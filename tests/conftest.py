@@ -28,7 +28,10 @@ if "xla_cpu_enable_fast_math" not in prev:
 
 import jax  # noqa: E402  (preloaded anyway; config must precede backend init)
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# SR_TPU_TESTS=1 keeps the real TPU platform (for tests/test_pallas.py etc.);
+# default is the 8-device virtual CPU platform.
+if os.environ.get("SR_TPU_TESTS") != "1":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,41 @@
+"""Pallas kernel parity vs. the scan interpreter (runs only on TPU hardware;
+the CPU test platform cannot lower Mosaic kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.models.population import Population
+from symbolicregression_jl_tpu.ops import flatten_trees
+from symbolicregression_jl_tpu.ops.interp import eval_trees
+from symbolicregression_jl_tpu.ops.interp_pallas import eval_trees_pallas, pallas_supported
+
+pytestmark = pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu", reason="Pallas kernel needs TPU"
+)
+
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/", "pow"],
+    unary_operators=["cos", "exp", "abs", "log", "sqrt"],
+    maxsize=20,
+    save_to_file=False,
+)
+
+
+def test_supported():
+    assert pallas_supported(OPTS.operators, 5)
+
+
+def test_parity_with_scan_interpreter():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, 777)).astype(np.float32)  # non-tile-aligned rows
+    trees = Population.random_trees(64, OPTS, 5, rng)
+    flat = flatten_trees(trees, OPTS.max_nodes)
+    want = np.asarray(eval_trees(flat, jnp.asarray(X), OPTS.operators))
+    got = np.asarray(eval_trees_pallas(flat, X, OPTS.operators))
+    both_nan = np.isnan(want) & np.isnan(got)
+    both_inf = np.isinf(want) & np.isinf(got)
+    ok = np.isclose(want, got, rtol=1e-4, atol=1e-4) | both_nan | both_inf
+    assert ok.mean() == 1.0, f"{(~ok).sum()} mismatches"
