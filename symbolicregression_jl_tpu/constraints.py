@@ -78,6 +78,12 @@ def check_constraints(
     size = compute_complexity(tree, options) if cursize is None else cursize
     if size > maxsize:
         return False
+    # Hard raw-node cap: the device tensors are sized to options.max_nodes, and
+    # with per-node complexities < 1 (or <= 0) the complexity check above does
+    # not bound node count (options.py sizes max_nodes accordingly). Skipped
+    # entirely when complexity >= 1 per node, where size <= maxsize implies it.
+    if options._needs_node_cap and tree.count_nodes() > options.max_nodes:
+        return False
     if tree.count_depth() > options.maxdepth:
         return False
     if _subtree_sizes_violate(tree, options):

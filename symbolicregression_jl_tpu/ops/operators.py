@@ -20,6 +20,7 @@ from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
     "Operator",
@@ -143,6 +144,7 @@ def gamma_full(x):
     refl = jnp.pi / (sin_pix * pos)
     out = jnp.where(x < 0, refl, jnp.exp(jax.lax.lgamma(jnp.where(x > 0, x, 1.0))))
     out = jnp.where(x == jnp.floor(x), jnp.where(x > 0, out, jnp.nan), out)
+    out = jnp.where(jnp.isnan(x), jnp.nan, out)
     return jnp.where(jnp.isfinite(out), out, jnp.nan)
 
 
@@ -159,7 +161,10 @@ def neg(x):
 
 
 def relu(x):
-    return (x > 0) * x
+    # NaN -> 0, matching Julia's strong-zero `(x > 0) * x` (false * NaN == 0;
+    # /root/reference/src/Operators.jl:90). `(x > 0) * x` in IEEE float math
+    # would give 0 * NaN == NaN instead.
+    return jnp.where(x > 0, x, 0.0)
 
 
 def sign_op(x):
@@ -218,19 +223,22 @@ def mod_op(x, y):
 
 
 def greater(x, y):
-    return (x > y) * jnp.ones_like(x)
+    # NaN operands -> 0 (comparison false), Julia strong-zero semantics.
+    return jnp.where(x > y, 1.0, 0.0) * jnp.ones_like(x)
 
 
 def cond_op(x, y):
-    return (x > 0) * y
+    # cond(NaN, y) == 0 and cond(x<=0, NaN) == 0, per Julia `(x > 0) * y`
+    # where false is a strong zero (/root/reference/src/Operators.jl:88).
+    return jnp.where(x > 0, y, jnp.zeros_like(y))
 
 
 def logical_or(x, y):
-    return ((x > 0) | (y > 0)) * jnp.ones_like(x)
+    return jnp.where((x > 0) | (y > 0), 1.0, 0.0) * jnp.ones_like(x)
 
 
 def logical_and(x, y):
-    return ((x > 0) & (y > 0)) * jnp.ones_like(x)
+    return jnp.where((x > 0) & (y > 0), 1.0, 0.0) * jnp.ones_like(x)
 
 
 def max_op(x, y):
@@ -248,20 +256,31 @@ def min_op(x, y):
 
 
 def k_safe_pow(x, y):
-    """safe_pow using exp/log and float parity arithmetic only."""
+    """safe_pow using exp/log and float parity arithmetic only.
+
+    The invalid mask is pure boolean algebra (&, |, ~ over comparisons) —
+    ``jnp.where`` over boolean operands lowers to a select on i1 vectors,
+    which Mosaic rejects ("Unsupported target bitwidth for truncation",
+    arith.trunci i8 -> i1)."""
     yi = jnp.floor(y + 0.5)
     y_is_int = y == yi
-    invalid = jnp.where(
-        y_is_int,
-        (yi < 0) & (x == 0),
-        jnp.where(y > 0, x < 0, x <= 0),
+    # ~(y > 0) rather than (y <= 0) so a NaN exponent lands in the x <= 0
+    # check (NaN compares false to everything), matching the where-based mask.
+    invalid = (y_is_int & (yi < 0) & (x == 0)) | (
+        (~y_is_int) & (((y > 0) & (x < 0)) | ((~(y > 0)) & (x <= 0)))
     )
     ax = jnp.abs(x)
     ax_safe = jnp.where(invalid | (ax == 0), 1.0, ax)
     mag = jnp.exp(y * jnp.log(ax_safe))
+    # IEEE pow: x**0 == 1 and 1**y == 1 even for NaN operands — the exp/log
+    # form would give NaN there.
+    mag = jnp.where(y == 0.0, 1.0, mag)
+    mag = jnp.where(ax == 1.0, 1.0, mag)  # invalid lanes overridden below
     mag = jnp.where(ax == 0, jnp.where(y == 0, 1.0, 0.0), mag)
     half = yi * 0.5
-    odd = (half - jnp.floor(half)) != 0.0
+    # non-finite yi makes (half - floor(half)) NaN (!= 0 -> true); IEEE
+    # pow(±1, ±inf) == 1 and |x|^±inf carries no sign, so mask those lanes
+    odd = ((half - jnp.floor(half)) != 0.0) & (jnp.abs(yi) < jnp.inf)
     signed = jnp.where((x < 0) & odd, -mag, mag)
     return jnp.where(invalid, jnp.nan, signed)
 
@@ -337,10 +356,68 @@ def k_gamma(x):
     return jnp.where(jnp.isfinite(out), out, jnp.nan)
 
 
+def k_sinh(x):
+    # exp(|x| - ln2) keeps the large-|x| range of f32 sinh (plain exp(x)
+    # overflows ~0.7 earlier); the Taylor branch avoids the catastrophic
+    # cancellation of 0.5*(e - 1/e) near 0.
+    a = jnp.abs(x)
+    half_e = jnp.exp(a - 0.6931471805599453)  # e^|x| / 2
+    big = jnp.sign(x) * (half_e - 0.25 / half_e)
+    x2 = x * x
+    small = x + x * x2 * (1.0 / 6.0 + x2 * (1.0 / 120.0))
+    return jnp.where(a < 0.5, small, big)
+
+
+def k_cosh(x):
+    a = jnp.abs(x)
+    half_e = jnp.exp(a - 0.6931471805599453)
+    return half_e + 0.25 / half_e
+
+
+def k_atan(x):
+    """Cephes atanf: octant range reduction + degree-4 minimax polynomial."""
+    s = jnp.sign(x)
+    a = jnp.abs(x)
+    big = a > 2.414213562373095  # tan(3pi/8)
+    mid = a > 0.4142135623730950  # tan(pi/8)
+    t = jnp.where(
+        big,
+        -1.0 / jnp.where(a == 0, 1.0, a),
+        jnp.where(mid, (a - 1.0) / (a + 1.0), a),
+    )
+    z = t * t
+    p = ((8.05374449538e-2 * z - 1.38776856032e-1) * z + 1.99777106478e-1) * z
+    y = (p - 3.33329491539e-1) * z * t + t
+    y = y + jnp.where(big, 1.5707963267948966, jnp.where(mid, 0.7853981633974483, 0.0))
+    return s * y
+
+
+def k_asin(x):
+    bad = jnp.abs(x) > 1
+    xs = jnp.where(bad, 0.0, x)
+    denom = jnp.sqrt(jnp.maximum(1.0 - xs * xs, 0.0))
+    at_one = denom == 0.0
+    r = k_atan(xs / jnp.where(at_one, 1.0, denom))
+    r = jnp.where(at_one, jnp.sign(xs) * 1.5707963267948966, r)
+    return jnp.where(bad, jnp.nan, r)
+
+
+def k_acos(x):
+    bad = jnp.abs(x) > 1
+    r = 1.5707963267948966 - k_asin(jnp.where(bad, 0.0, x))
+    return jnp.where(bad, jnp.nan, r)
+
+
 def k_round(x):
-    """Round-half-away-from-zero via floor (jnp.round's bankers' rounding
-    differs at exact halves — acceptable for kernel use, documented)."""
-    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+    """Bankers' rounding (round-half-to-even), matching jnp.round and Julia's
+    default RoundNearest, in float-only Mosaic-safe arithmetic."""
+    r = jnp.floor(x + 0.5)
+    tie = (r - x) == 0.5
+    r_half = r * 0.5
+    r_odd = (r_half - jnp.floor(r_half)) != 0.0
+    r = jnp.where(tie & r_odd, r - 1.0, r)
+    # |x| >= 2^23: every f32 is already an integer and x + 0.5 rounds away
+    return jnp.where(jnp.abs(x) >= 8388608.0, x, r)
 
 
 def _u(name, fn, display=None, kernel_fn=None):
@@ -367,12 +444,12 @@ UNARY_OPS: dict[str, Operator] = {
         _u("sin", jnp.sin),
         _u("cos", jnp.cos),
         _u("tan", jnp.tan),
-        _u("sinh", jnp.sinh),
-        _u("cosh", jnp.cosh),
+        _u("sinh", jnp.sinh, kernel_fn=k_sinh),
+        _u("cosh", jnp.cosh, kernel_fn=k_cosh),
         _u("tanh", jnp.tanh),
-        _u("asin", safe_asin),
-        _u("acos", safe_acos),
-        _u("atan", jnp.arctan),
+        _u("asin", safe_asin, kernel_fn=k_asin),
+        _u("acos", safe_acos, kernel_fn=k_acos),
+        _u("atan", jnp.arctan, kernel_fn=k_atan),
         _u("asinh", jnp.arcsinh, kernel_fn=k_asinh),
         _u("acosh", safe_acosh, kernel_fn=k_acosh),
         _u("atanh", safe_atanh, kernel_fn=k_atanh),
@@ -534,6 +611,15 @@ _NAN = float("nan")
 
 
 def _s_pow(x, y):
+    if _math.isnan(x) or _math.isnan(y):
+        # IEEE pow exceptions: pow(x, 0) == 1 and pow(1, y) == 1 even for NaN
+        return 1.0 if (x == 1.0 or y == 0.0) else _NAN
+    if _math.isinf(y):
+        # jnp.round(±inf) == ±inf, so the JAX fn takes the integer-y branch:
+        # NaN only for x == 0 with y == -inf; otherwise IEEE pow semantics
+        if x == 0 and y < 0:
+            return _NAN
+        return float(_math.pow(x, y))
     yi = round(y)
     if y == yi:
         if yi < 0 and x == 0:
@@ -548,6 +634,15 @@ def _s_pow(x, y):
         return float(_math.pow(x, y))
     except OverflowError:
         return float("inf")
+
+
+def _s_mod(x, y):
+    if y == 0 or _math.isnan(x) or _math.isnan(y) or _math.isinf(x):
+        return _NAN
+    if _math.isinf(y):
+        # floored modulo takes y's sign: x when signs agree (or x == 0), else y
+        return float(x) if (x == 0 or (x > 0) == (y > 0)) else y
+    return _math.fmod(_math.fmod(x, y) + y, y)
 
 
 def _s_gamma(x):
@@ -579,7 +674,7 @@ SCALAR_IMPLS: dict[str, Callable] = {
     "neg": lambda x: -x,
     "square": lambda x: x * x,
     "cube": lambda x: x * x * x,
-    "exp": lambda x: _math.exp(x) if x < 709 else float("inf"),
+    "exp": lambda x: _NAN if _math.isnan(x) else (_math.exp(x) if x < 709 else float("inf")),
     "abs": abs,
     "log": _guard_s(_math.log, lambda x: x <= 0),
     "log2": _guard_s(_math.log2, lambda x: x <= 0),
@@ -589,8 +684,12 @@ SCALAR_IMPLS: dict[str, Callable] = {
     "sin": _math.sin,
     "cos": _math.cos,
     "tan": _math.tan,
-    "sinh": lambda x: _math.sinh(x) if abs(x) < 710 else _math.copysign(float("inf"), x),
-    "cosh": lambda x: _math.cosh(x) if abs(x) < 710 else float("inf"),
+    "sinh": lambda x: _NAN if _math.isnan(x) else (
+        _math.sinh(x) if abs(x) < 710 else _math.copysign(float("inf"), x)
+    ),
+    "cosh": lambda x: _NAN if _math.isnan(x) else (
+        _math.cosh(x) if abs(x) < 710 else float("inf")
+    ),
     "tanh": _math.tanh,
     "asin": _guard_s(_math.asin, lambda x: abs(x) > 1),
     "acos": _guard_s(_math.acos, lambda x: abs(x) > 1),
@@ -606,21 +705,23 @@ SCALAR_IMPLS: dict[str, Callable] = {
     "gamma": _s_gamma,
     "relu": lambda x: x if x > 0 else 0.0,
     "round": lambda x: float(np.round(x)),  # banker's rounding, like jnp.round
-    "floor": _math.floor,
-    "ceil": _math.ceil,
+    "floor": lambda x: _NAN if _math.isnan(x) else float(_math.floor(x)),
+    "ceil": lambda x: _NAN if _math.isnan(x) else float(_math.ceil(x)),
     "sign": lambda x: _NAN if _math.isnan(x) else float(np.sign(x)),
     "add": lambda x, y: x + y,
     "sub": lambda x, y: x - y,
     "mult": lambda x, y: x * y,
     "div": _s_div,
     "pow": _s_pow,
-    "mod": lambda x, y: _NAN if y == 0 else _math.fmod(_math.fmod(x, y) + y, y),
+    "mod": _s_mod,
     "greater": lambda x, y: 1.0 if x > y else 0.0,
     "cond": lambda x, y: y if x > 0 else 0.0,
     "logical_or": lambda x, y: 1.0 if (x > 0 or y > 0) else 0.0,
     "logical_and": lambda x, y: 1.0 if (x > 0 and y > 0) else 0.0,
-    "max": lambda x, y: max(x, y),
-    "min": lambda x, y: min(x, y),
+    # NaN-propagating like jnp.maximum/minimum (Python's max/min would return
+    # an operand arbitrarily when comparisons with NaN are false)
+    "max": lambda x, y: _NAN if (_math.isnan(x) or _math.isnan(y)) else max(x, y),
+    "min": lambda x, y: _NAN if (_math.isnan(x) or _math.isnan(y)) else min(x, y),
 }
 
 

@@ -168,9 +168,6 @@ class Options:
             # Reference disables auto-simplify when a full custom objective is
             # used (the objective may depend on exact tree shape).
             self.should_simplify = self.loss_function is None
-        # +2 head-room matches the reference's hall-of-fame sizing
-        # (members[1:maxsize+MAX_DEGREE], /root/reference/src/HallOfFame.jl:45-63).
-        self.max_nodes = pad_bucket(self.maxsize + 2, self.pad_multiple)
         if self.deterministic and self.seed is None:
             self.seed = 0
 
@@ -179,6 +176,30 @@ class Options:
             self.nested_constraints, self.operators
         )
         self._complexity_mapping = _complexity_mapping(self)
+        # +2 head-room matches the reference's hall-of-fame sizing
+        # (members[1:maxsize+MAX_DEGREE], /root/reference/src/HallOfFame.jl:45-63).
+        # Complexity != node count when custom per-node complexities < 1 exist:
+        # a constraint-passing tree may then hold up to maxsize/min_complexity
+        # nodes, so the device node budget is sized from that bound.
+        # check_constraints additionally enforces count_nodes() <= max_nodes as
+        # a hard cap (load-bearing when some complexity is <= 0, where the
+        # complexity metric cannot bound node count at all).
+        node_budget = self.maxsize + 2
+        cm = self._complexity_mapping
+        min_c = 1.0
+        if cm is not None:
+            min_c = min(
+                float(np.min(cm["binop"])) if cm["binop"].size else np.inf,
+                float(np.min(cm["unaop"])) if cm["unaop"].size else np.inf,
+                float(cm["constant"]),
+                float(np.min(cm["variable"])),
+            )
+            if 0 < min_c < 1:
+                node_budget = int(np.ceil(self.maxsize / min_c)) + 2
+        self.max_nodes = pad_bucket(node_budget, self.pad_multiple)
+        # Node-cap traversal in check_constraints is only needed when the
+        # complexity metric cannot bound node count (some complexity < 1).
+        self._needs_node_cap = min_c < 1
         # Geometric tournament weights p*(1-p)^k, precomputed like the
         # reference (/root/reference/src/Options.jl:713-720).
         p = self.tournament_selection_p

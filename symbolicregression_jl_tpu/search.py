@@ -263,7 +263,16 @@ def equation_search(
     nout = ys.shape[0]
     if weights is not None:
         weights = np.asarray(weights)
-        ws = weights if weights.ndim == 2 else weights[None, :]
+        if weights.ndim == 2:
+            ws = weights
+        else:
+            # 1-D weights apply to every output row (reference reshapes
+            # weights alongside y, /root/reference/src/SymbolicRegression.jl:387-398).
+            ws = np.broadcast_to(weights[None, :], (nout, weights.shape[-1]))
+        if ws.shape != ys.shape:
+            raise ValueError(
+                f"weights shape {weights.shape} incompatible with y shape {y.shape}"
+            )
     else:
         ws = [None] * nout
 

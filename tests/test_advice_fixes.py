@@ -1,0 +1,222 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. SCALAR_IMPLS must exist and agree with the JAX implementation for every
+   built-in operator (the `np`-using entries crashed with NameError before).
+2. max_nodes must bound *node count*, not complexity, when custom per-node
+   complexities < 1 are configured.
+3. 1-D weights must broadcast across multi-output y.
+4. relu/cond/greater NaN semantics: JAX and scalar impls both follow Julia's
+   strong-zero convention (false * NaN == 0).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.constraints import check_constraints
+from symbolicregression_jl_tpu.ops.operators import (
+    BINARY_OPS,
+    UNARY_OPS,
+    Operator,
+    scalar_impl,
+)
+from symbolicregression_jl_tpu.tree import binary, constant, feature
+
+
+# -- 1: scalar impl coverage + JAX parity -----------------------------------
+
+_SAMPLES_1 = [-2.5, -1.0, -0.5, 0.0, 0.5, 1.0, 2.5, float("nan")]
+_SAMPLES_2 = [
+    (a, b)
+    for a in (-2.0, -1.0, -0.5, 0.0, 1.0, 1.5, float("nan"))
+    for b in (-2.0, 0.0, 0.5, 3.0, float("nan"), float("inf"), float("-inf"))
+]
+
+
+@pytest.mark.parametrize("name", sorted(UNARY_OPS))
+def test_scalar_impl_matches_jax_unary(name):
+    op = UNARY_OPS[name]
+    s = scalar_impl(op)
+    for x in _SAMPLES_1:
+        got = s(x)
+        want = float(np.asarray(op.fn(np.float64(x))))
+        if math.isnan(want):
+            assert math.isnan(got), f"{name}({x}): scalar {got}, jax NaN"
+        else:
+            assert got == pytest.approx(want, rel=1e-6, abs=1e-9), f"{name}({x})"
+
+
+@pytest.mark.parametrize("name", sorted(BINARY_OPS))
+def test_scalar_impl_matches_jax_binary(name):
+    op = BINARY_OPS[name]
+    s = scalar_impl(op)
+    for x, y in _SAMPLES_2:
+        got = s(x, y)
+        want = float(np.asarray(op.fn(np.float64(x), np.float64(y))))
+        if math.isnan(want):
+            assert math.isnan(got), f"{name}({x},{y}): scalar {got}, jax NaN"
+        elif math.isinf(want):
+            assert math.isinf(got) and (got > 0) == (want > 0), f"{name}({x},{y})"
+        else:
+            assert got == pytest.approx(want, rel=1e-6, abs=1e-9), f"{name}({x},{y})"
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, op in {**UNARY_OPS, **BINARY_OPS}.items() if op.kernel_fn)
+)
+def test_kernel_fn_matches_fn(name):
+    """Mosaic-safe kernel variants must agree with the XLA implementation —
+    including NaN-ness, which drives accept/reject parity between the Pallas
+    and interpreter scoring paths."""
+    op = {**UNARY_OPS, **BINARY_OPS}[name]
+    if op.arity == 1:
+        args_list = [(np.float32(x),) for x in _SAMPLES_1]
+    else:
+        args_list = [(np.float32(a), np.float32(b)) for a, b in _SAMPLES_2]
+    for args in args_list:
+        want = float(np.asarray(op.fn(*args)))
+        got = float(np.asarray(op.kernel_fn(*args)))
+        if math.isnan(want):
+            assert math.isnan(got), f"{name}{args}: kernel {got}, fn NaN"
+        elif math.isinf(want):
+            assert math.isinf(got) and (got > 0) == (want > 0), f"{name}{args}"
+        else:
+            assert got == pytest.approx(want, rel=2e-4, abs=1e-6), f"{name}{args}"
+
+
+def test_kernel_sinh_small_and_large():
+    from symbolicregression_jl_tpu.ops.operators import k_cosh, k_sinh
+
+    xs = np.array([1e-6, 1e-4, 0.3, 1.0, 89.0, -89.0, -1e-5], np.float32)
+    sinh_want = np.sinh(xs.astype(np.float64)).astype(np.float32)
+    cosh_want = np.cosh(xs.astype(np.float64)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(k_sinh(xs)), sinh_want, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(k_cosh(xs)), cosh_want, rtol=1e-5)
+
+
+def test_kernel_round_large_integers():
+    from symbolicregression_jl_tpu.ops.operators import k_round
+
+    xs = np.array([8388609.0, -8388609.0, 2.5, -2.5, 3.5, 0.5], np.float32)
+    np.testing.assert_array_equal(np.asarray(k_round(xs)), np.round(xs))
+
+
+def test_scalar_impl_custom_operator_fallback():
+    import jax.numpy as jnp
+
+    custom = Operator(name="twox", arity=1, fn=lambda x: 2.0 * x)
+    assert scalar_impl(custom)(3.0) == pytest.approx(6.0)
+
+
+def test_search_with_round_operator_simplifies():
+    # ADVICE #1 repro: round/sign SCALAR_IMPLS used numpy without importing it;
+    # constant folding during simplify crashed with NameError.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = np.round(X[0]) + X[1]
+    options = Options(
+        binary_operators=["+", "-"],
+        unary_operators=["round", "sign"],
+        populations=2,
+        population_size=12,
+        ncycles_per_iteration=30,
+        maxsize=8,
+        save_to_file=False,
+        seed=0,
+    )
+    result = equation_search(X, y.astype(np.float32), options=options, niterations=1, verbosity=0)
+    assert result.hall_of_fame is not None
+
+
+# -- 2: max_nodes sized from node count, not complexity ---------------------
+
+def test_max_nodes_with_fractional_complexity():
+    options = Options(
+        binary_operators=["+"],
+        maxsize=8,
+        complexity_of_operators={"+": 0.25},
+        complexity_of_constants=0.25,
+        complexity_of_variables=0.25,
+        save_to_file=False,
+    )
+    # a balanced add tree: complexity 0.25/node -> up to 32 nodes pass maxsize
+    def balanced(d):
+        if d == 0:
+            return feature(0)
+        return binary(0, balanced(d - 1), balanced(d - 1))
+
+    t = balanced(4)  # 31 nodes, depth 5, complexity 7.75
+    assert check_constraints(t, options)
+    assert t.count_nodes() <= options.max_nodes  # flatten_trees cannot raise
+
+
+def test_node_cap_enforced_when_complexity_nonpositive():
+    options = Options(
+        binary_operators=["+"],
+        maxsize=8,
+        complexity_of_operators={"+": 0.0},
+        save_to_file=False,
+    )
+    t = constant(1.0)
+    while t.count_nodes() <= options.max_nodes:
+        t = binary(0, t, feature(0))
+    # complexity-wise legal (all operators free), but raw node cap rejects it
+    assert not check_constraints(t, options)
+
+
+# -- 3: 1-D weights with multi-output y -------------------------------------
+
+def test_weights_broadcast_multioutput():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 48)).astype(np.float32)
+    y = np.stack([X[0] + X[1], X[0] - X[1]]).astype(np.float32)
+    w = np.abs(rng.normal(size=(48,))).astype(np.float32) + 0.1
+    options = Options(
+        populations=2,
+        population_size=10,
+        ncycles_per_iteration=20,
+        maxsize=6,
+        save_to_file=False,
+        seed=0,
+    )
+    results = equation_search(
+        X, y, weights=w, options=options, niterations=1, verbosity=0
+    )
+    assert len(results) == 2
+
+
+def test_weights_shape_mismatch_raises():
+    X = np.zeros((2, 10), np.float32)
+    y = np.zeros((2, 10), np.float32)
+    with pytest.raises(ValueError, match="weights"):
+        equation_search(
+            X, y, weights=np.ones((3, 10), np.float32),
+            options=Options(save_to_file=False), niterations=1, verbosity=0,
+        )
+
+
+# -- 4: strong-zero NaN semantics -------------------------------------------
+
+def test_strong_zero_nan_semantics():
+    nan = float("nan")
+    cases = [
+        ("relu", (nan,), 0.0),
+        ("greater", (nan, 1.0), 0.0),
+        ("greater", (1.0, nan), 0.0),
+        ("cond", (nan, 5.0), 0.0),
+        ("cond", (-1.0, nan), 0.0),
+        ("logical_or", (nan, nan), 0.0),
+        ("logical_and", (nan, 1.0), 0.0),
+    ]
+    for name, args, want in cases:
+        table = UNARY_OPS if len(args) == 1 else BINARY_OPS
+        op = table[name]
+        jax_val = float(np.asarray(op.fn(*[np.float32(a) for a in args])))
+        scalar_val = scalar_impl(op)(*args)
+        assert jax_val == want, f"jax {name}{args} -> {jax_val}"
+        assert scalar_val == want, f"scalar {name}{args} -> {scalar_val}"
+    # cond with a positive gate still propagates NaN from the value side
+    assert math.isnan(float(np.asarray(BINARY_OPS["cond"].fn(np.float32(1.0), np.float32(nan)))))
+    assert math.isnan(scalar_impl(BINARY_OPS["cond"])(1.0, nan))
