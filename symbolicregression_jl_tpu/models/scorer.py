@@ -38,12 +38,24 @@ class BatchScorer:
         self._sharded = None
         if options.data_sharding == "rows":
             self._setup_row_sharding()
-        # Mosaic kernel forward path: probe once per operator set; falls back
-        # to the scan interpreter off-TPU or for non-lowerable operators.
-        if self._sharded is None:
-            from ..ops.interp_pallas import pallas_supported
+        # Fused Mosaic loss kernel: probe once per (operator set, loss); falls
+        # back to the scan interpreter off-TPU, for non-lowerable operators,
+        # or for non-float32 compute dtypes (the kernel is f32-only).
+        self._pallas_loss = None
+        if self._sharded is None and np.dtype(self.dtype) == np.float32:
+            from ..ops.interp_pallas import make_pallas_loss_fn, pallas_supported
 
-            self.use_pallas = pallas_supported(self.opset, dataset.n_features)
+            self.use_pallas = pallas_supported(
+                self.opset, dataset.n_features, self.loss_elem
+            )
+            if self.use_pallas:
+                self._pallas_loss = make_pallas_loss_fn(
+                    dataset.X,
+                    dataset.y,
+                    dataset.weights,
+                    self.opset,
+                    self.loss_elem,
+                )
         else:
             self.use_pallas = False
         bl, use = baseline_loss(dataset, self.opset, self.loss_elem, self.dtype)
@@ -114,9 +126,20 @@ class BatchScorer:
             fs = shard_population(self._mesh, flat)
             w_arg = self.w if self.w is not None else jnp.zeros((), self.dtype)
             dev_losses = self._sharded(fs, self.X, self.y, w_arg)
+        elif self._pallas_loss is not None and idx is None:
+            dev_losses = self._pallas_loss(flat)
+        elif self._pallas_loss is not None and len(idx) >= 2048:
+            # Large minibatches: fused kernel with the in-graph reshape path.
+            # (Its row tile is fixed at 10240, so small batches would waste
+            # >5x compute in padding — those use the scan interpreter below.)
+            from ..ops.interp_pallas import loss_trees_pallas_batch
+
+            dev_losses = loss_trees_pallas_batch(
+                flat, X, y, w, self.opset, self.loss_elem
+            )
         else:
             dev_losses = batched_loss_jit(
-                flat, X, y, w, self.opset, self.loss_elem, use_pallas=self.use_pallas
+                flat, X, y, w, self.opset, self.loss_elem, use_pallas=False
             )
         try:
             dev_losses.copy_to_host_async()

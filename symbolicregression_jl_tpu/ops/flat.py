@@ -26,6 +26,7 @@ __all__ = [
     "KIND_UNARY",
     "KIND_BINARY",
     "FlatTrees",
+    "FlatSlab",
     "flatten_trees",
     "unflatten_tree",
     "pad_bucket",
@@ -127,6 +128,67 @@ def flatten_trees(
         length[p] = len(post)
 
     return FlatTrees(kind, op, lhs, rhs, feat, val, length)
+
+
+class FlatSlab:
+    """Persistent population slab in the fused Mosaic kernel's packed layout.
+
+    Owns ints [capacity, L] (code | lhs | rhs | feat | length per tree, where
+    code = 0 const, 1 var, 2+op unary, 2+n_unary+op binary) and vals
+    [capacity, Lv]. Callers re-flatten ONLY the members that changed
+    (``set_tree``), so steady-state host cost is proportional to the mutation
+    rate, not the population size. Feeds make_packed_loss_fn directly —
+    no per-sweep concatenation or re-padding.
+
+    NOTE: this writer, flatten_trees, and pack_flat_fused (interp_pallas.py)
+    must agree on the packed layout; tests/test_pallas.py's
+    test_packed_slab_matches_flatten pins slab == flatten+pack agreement.
+    """
+
+    def __init__(self, capacity: int, n_slots: int, opset, dtype=np.float32):
+        def _ru(n, m=128):
+            return ((n + m - 1) // m) * m
+
+        self.capacity = capacity
+        self.n_slots = n_slots
+        self.opset = opset
+        self.L = _ru(4 * n_slots + 1)
+        self.Lv = _ru(n_slots)
+        self.ints = np.zeros((capacity, self.L), np.int32)
+        self.vals = np.zeros((capacity, self.Lv), dtype)
+        self._una_off = 2
+        self._bin_off = 2 + opset.n_unary
+
+    def set_tree(self, i: int, tree: Node) -> None:
+        N = self.n_slots
+        row = self.ints[i]
+        vrow = self.vals[i]
+        row[: 4 * N + 1] = 0
+        vrow[:N] = 0
+        post = tree.postorder()
+        if len(post) > N:
+            raise ValueError(f"tree has {len(post)} nodes > n_slots={N}")
+        slot_of = {}
+        for s, n in enumerate(post):
+            slot_of[id(n)] = s
+            if n.degree == 0:
+                if n.is_const:
+                    vrow[s] = n.val
+                else:
+                    row[s] = 1
+                    row[3 * N + s] = n.feat
+            elif n.degree == 1:
+                row[s] = self._una_off + n.op
+                row[N + s] = slot_of[id(n.l)]
+            else:
+                row[s] = self._bin_off + n.op
+                row[N + s] = slot_of[id(n.l)]
+                row[2 * N + s] = slot_of[id(n.r)]
+        row[4 * N] = len(post)
+
+    def set_trees(self, trees: list[Node], start: int = 0) -> None:
+        for k, t in enumerate(trees):
+            self.set_tree(start + k, t)
 
 
 def unflatten_tree(flat: FlatTrees, p: int) -> Node:

@@ -35,10 +35,16 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .flat import KIND_CONST, FlatTrees
+from .flat import KIND_BINARY, KIND_CONST, KIND_UNARY, KIND_VAR, FlatTrees
 from .operators import OperatorSet
 
-__all__ = ["eval_trees_pallas", "pallas_supported"]
+__all__ = [
+    "eval_trees_pallas",
+    "loss_trees_pallas",
+    "make_pallas_loss_fn",
+    "make_packed_loss_fn",
+    "pallas_supported",
+]
 
 
 def _round_up(n: int, m: int) -> int:
@@ -176,7 +182,7 @@ def pack_flat(flat: FlatTrees):
 
 
 def eval_trees_pallas(
-    flat: FlatTrees, X, opset: OperatorSet, r_tile: int = 1024, p_tile: int = 8
+    flat: FlatTrees, X, opset: OperatorSet, r_tile: int = 1280, p_tile: int = 8
 ) -> jax.Array:
     """preds [P, R] via the Pallas kernel. X: [F, R] float32.
 
@@ -198,15 +204,384 @@ def eval_trees_pallas(
     return preds[:, :R]
 
 
+# ---------------------------------------------------------------------------
+# Fused loss kernel (v2): the scoring fast path.
+#
+# Differences vs. eval_trees_pallas above (which is kept for preds-shaped
+# callers and tests):
+#   1. Row layout (8, cols): rows are reshaped into 8 VPU sublanes x cols so
+#      every per-slot vector op runs on full (8, 128)-tiles — the (1, r_tile)
+#      layout above uses 1 of 8 sublanes.
+#   2. The elementwise loss + masked weighted reduction + finiteness check are
+#      fused into the kernel: output is per-tree partial sums, never a [P, R]
+#      prediction matrix in HBM (the reference reduces eval to a loss scalar
+#      per tree the same way: /root/reference/src/LossFunctions.jl:45-75).
+#   3. One fused opcode switch (const | var | una_0.. | bin_0..) instead of a
+#      kind-switch nesting an op-switch.
+#   4. Tree structure is DMA'd once per p-tile (the r-grid above re-copied it
+#      for every row tile).
+#
+# All vector refs share one lane width C_TILE — this backend aborts when
+# kernels with different lane widths run in one process (see note on
+# eval_trees_pallas).
+# ---------------------------------------------------------------------------
+
+C_TILE = 1280  # fixed lane width; row block = 8 * C_TILE = 10240 rows
+P_TILE_LOSS = 16
+
+
+def _make_loss_kernel(
+    opset: OperatorSet, loss_elem, n_slots: int, p_tile: int, c_tile: int, C: int, R: int
+):
+    unary_fns = [op.kernel_fn or op.fn for op in opset.unary]
+    binary_fns = [op.kernel_fn or op.fn for op in opset.binary]
+    N = n_slots
+
+    def kernel(ints_hbm, vals_hbm, x_ref, y_ref, w_ref, out_ref, ints_s, vals_s, buf_ref, sems):
+        p = pl.program_id(0)
+        t = pl.program_id(1)
+        start = p * p_tile
+
+        @pl.when(t == 0)
+        def _init():
+            # SMEM/VMEM scratch persists across the sequential t steps of one
+            # p-tile, so tree structure is DMA'd once per p-tile, and the
+            # output accumulator is zeroed on the first column tile.
+            out_ref[...] = jnp.zeros_like(out_ref)
+            c1 = pltpu.make_async_copy(
+                ints_hbm.at[pl.ds(start, p_tile), :], ints_s, sems.at[0]
+            )
+            c2 = pltpu.make_async_copy(
+                vals_hbm.at[pl.ds(start, p_tile), :], vals_s, sems.at[1]
+            )
+            c1.start()
+            c2.start()
+            c1.wait()
+            c2.wait()
+
+        yv = y_ref[...]  # (8, c_tile)
+        wv = w_ref[...]
+        # global row index of lane (sub, col) in this tile; rows >= R are pad
+        sub = lax.broadcasted_iota(jnp.int32, (8, c_tile), 0)
+        col = lax.broadcasted_iota(jnp.int32, (8, c_tile), 1)
+        mask = sub * C + t * c_tile + col < R
+        wm = jnp.where(mask, wv, 0.0)
+        lane = lax.broadcasted_iota(jnp.int32, (1, c_tile), 1)
+
+        def tree_body(ti, _):
+            length = ints_s[ti, 4 * N]
+
+            def slot_body(i, _):
+                code = ints_s[ti, i]
+                li = ints_s[ti, N + i]
+                ri = ints_s[ti, 2 * N + i]
+                i8 = pl.multiple_of(i * 8, 8)
+
+                # Predicated blocks (real scalar branches) instead of a
+                # value-returning lax.switch: Mosaic lowers the latter to
+                # evaluate-every-branch + select, which costs n_ops x the
+                # vector work per slot.
+                @pl.when(code == 0)
+                def _const():
+                    buf_ref[pl.ds(i8, 8), :] = jnp.full(
+                        (8, c_tile), vals_s[ti, i], dtype=jnp.float32
+                    )
+
+                @pl.when(code == 1)
+                def _var():
+                    f8 = pl.multiple_of(ints_s[ti, 3 * N + i] * 8, 8)
+                    buf_ref[pl.ds(i8, 8), :] = x_ref[pl.ds(f8, 8), :]
+
+                for k, fn in enumerate(unary_fns):
+
+                    @pl.when(code == 2 + k)
+                    def _una(fn=fn):
+                        l8 = pl.multiple_of(li * 8, 8)
+                        buf_ref[pl.ds(i8, 8), :] = fn(buf_ref[pl.ds(l8, 8), :])
+
+                for k, fn in enumerate(binary_fns):
+
+                    @pl.when(code == 2 + len(unary_fns) + k)
+                    def _bin(fn=fn):
+                        l8 = pl.multiple_of(li * 8, 8)
+                        r8 = pl.multiple_of(ri * 8, 8)
+                        buf_ref[pl.ds(i8, 8), :] = fn(
+                            buf_ref[pl.ds(l8, 8), :], buf_ref[pl.ds(r8, 8), :]
+                        )
+
+                return 0
+
+            lax.fori_loop(0, length, slot_body, 0, unroll=False)
+
+            root8 = pl.multiple_of((length - 1) * 8, 8)
+            pred = buf_ref[pl.ds(root8, 8), :]  # (8, c_tile)
+            elem = loss_elem(pred, yv)
+            loss_part = jnp.sum(jnp.where(mask, elem * wv, 0.0))
+            wsum_part = jnp.sum(wm)
+            nonfin_part = jnp.sum(
+                jnp.where(mask & ~jnp.isfinite(pred), 1.0, 0.0)
+            )
+            row = (
+                jnp.where(lane == 0, loss_part, 0.0)
+                + jnp.where(lane == 1, wsum_part, 0.0)
+                + jnp.where(lane == 2, nonfin_part, 0.0)
+            )
+            out_ref[pl.ds(ti, 1), :] = out_ref[pl.ds(ti, 1), :] + row
+            return 0
+
+        lax.fori_loop(0, p_tile, tree_body, 0)
+
+    kernel.__name__ = (
+        f"sr_loss_n{n_slots}_p{p_tile}_c{c_tile}_C{C}_R{R}"
+        f"_h{hash(opset) & 0xFFFFFFFF:x}_l{_loss_uid(loss_elem)}"
+    )
+    return kernel
+
+
+# Stable per-callable ids for kernel naming. Keyed on the callable OBJECT
+# (strong ref — prevents GC id reuse from aliasing two different losses to one
+# executable-cache name).
+_LOSS_UIDS: dict = {}
+
+
+def _loss_uid(loss_elem) -> int:
+    if loss_elem not in _LOSS_UIDS:
+        _LOSS_UIDS[loss_elem] = len(_LOSS_UIDS)
+    return _LOSS_UIDS[loss_elem]
+
+
+def _name_with_P(kernel, P: int):
+    """The executable cache is keyed on kernel name; two programs that differ
+    only in batch size P (grid size) MUST NOT share a name — observed: a small
+    P=512 call before a P=10240 call makes the latter ~5x slower."""
+    kernel.__name__ = f"{kernel.__name__}_P{P}"
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("opset", "loss_elem", "n_slots", "p_tile", "c_tile", "C", "R"),
+)
+def _loss_pallas(ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile, C, R):
+    P = ints.shape[0]
+    F = Xr.shape[0] // 8  # Xr is (F*8, C): feature f occupies sublane rows 8f..8f+8
+    n_c_tiles = C // c_tile
+    L = ints.shape[1]
+    Lv = vals.shape[1]
+    kernel = _name_with_P(
+        _make_loss_kernel(opset, loss_elem, n_slots, p_tile, c_tile, C, R), P
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((P, c_tile), jnp.float32),
+        grid=(P // p_tile, n_c_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # ints (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),  # vals (HBM)
+            pl.BlockSpec(
+                (F * 8, c_tile), lambda p, t: (0, t), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((8, c_tile), lambda p, t: (0, t), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, c_tile), lambda p, t: (0, t), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (p_tile, c_tile), lambda p, t: (p, 0), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.SMEM((p_tile, L), jnp.int32),
+            pltpu.SMEM((p_tile, Lv), jnp.float32),
+            pltpu.VMEM((n_slots * 8, c_tile), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(ints, vals, Xr, yr, wr)
+
+    loss_sum, w_sum, nonfin = out[:, 0], out[:, 1], out[:, 2]
+    return jnp.where(
+        (nonfin == 0) & (w_sum > 0), loss_sum / jnp.maximum(w_sum, 1e-30), jnp.inf
+    )
+
+
+def pack_flat_fused(flat: FlatTrees, opset: OperatorSet):
+    """Pack FlatTrees into the fused-opcode layout.
+    ints [P, L]: code | lhs | rhs | feat | length (L = roundup(4N+1, 128));
+    code = 0 const, 1 var, 2+op unary, 2+n_unary+op binary. vals [P, Lv]."""
+    kind = np.asarray(flat.kind)
+    op = np.asarray(flat.op)
+    P, N = kind.shape
+    code = np.zeros((P, N), np.int32)
+    code[kind == KIND_VAR] = 1
+    m = kind == KIND_UNARY
+    code[m] = 2 + op[m]
+    m = kind == KIND_BINARY
+    code[m] = 2 + opset.n_unary + op[m]
+    L = _round_up(4 * N + 1, 128)
+    Lv = _round_up(N, 128)
+    ints = np.concatenate(
+        [
+            code,
+            np.asarray(flat.lhs, np.int32),
+            np.asarray(flat.rhs, np.int32),
+            np.asarray(flat.feat, np.int32),
+            np.asarray(flat.length, np.int32)[:, None],
+        ],
+        axis=1,
+    )
+    ints = np.pad(ints, ((0, 0), (0, L - ints.shape[1])))
+    vals = np.pad(np.asarray(flat.val, np.float32), ((0, 0), (0, Lv - N)))
+    return jnp.asarray(ints), jnp.asarray(vals)
+
+
+def _reshape_rows(X, y, weights):
+    """Pad rows to a multiple of 8*C_TILE and fold them into (8, cols) VPU
+    sublane layout. Returns (Xr [F*8,C], yr [8,C], wr [8,C], C, R); feature f
+    occupies Xr sublane rows 8f..8f+8."""
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    F, R = X.shape
+    R_pad = _round_up(R, 8 * C_TILE)
+    C = R_pad // 8
+    Xp = np.full((F, R_pad), 1.0, np.float32)
+    Xp[:, :R] = X
+    yp = np.zeros((R_pad,), np.float32)
+    yp[:R] = y
+    wp = np.zeros((R_pad,), np.float32)
+    wp[:R] = 1.0 if weights is None else np.asarray(weights, np.float32)
+    return (
+        jnp.asarray(Xp.reshape(F * 8, C)),
+        jnp.asarray(yp.reshape(8, C)),
+        jnp.asarray(wp.reshape(8, C)),
+        C,
+        R,
+    )
+
+
+def make_pallas_loss_fn(X, y, weights, opset: OperatorSet, loss_elem):
+    """Build the scoring-loop fast path: reshapes the dataset into sublane
+    layout ONCE (device-resident), returns ``fn(flat) -> losses [P]``.
+
+    Matches batched_loss semantics: weighted normalized mean of
+    loss_elem(pred, y) over real rows, inf where any pred is non-finite
+    (/root/reference/src/LossFunctions.jl:45-75)."""
+    Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+
+    def fn(flat: FlatTrees) -> jax.Array:
+        P, N = flat.kind.shape
+        if P % P_TILE_LOSS != 0:
+            raise ValueError(f"P={P} must be a multiple of {P_TILE_LOSS}")
+        ints, vals = pack_flat_fused(flat, opset)
+        return _loss_pallas(
+            ints, vals, Xr, yr, wr, opset, loss_elem, N, P_TILE_LOSS, C_TILE, C, R
+        )
+
+    return fn
+
+
+def loss_trees_pallas(
+    flat: FlatTrees, X, y, weights, opset: OperatorSet, loss_elem
+) -> jax.Array:
+    """One-shot convenience wrapper over make_pallas_loss_fn (host-side
+    reshape per call — hot loops should hold a make_pallas_loss_fn closure)."""
+    return make_pallas_loss_fn(X, y, weights, opset, loss_elem)(flat)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("opset", "loss_elem", "n_slots", "has_weights", "R")
+)
+def _loss_pallas_dyn(ints, vals, X, y, w, opset, loss_elem, n_slots, has_weights, R):
+    """Fused loss with per-call dataset (minibatch path): the sublane pad +
+    reshape happens IN-GRAPH on device, so callers can pass fresh row subsets
+    without host-side repacking. One compile per (batch length R, statics)."""
+    F = X.shape[0]
+    R_pad = _round_up(R, 8 * C_TILE)
+    C = R_pad // 8
+    Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - R)), constant_values=1.0)
+    yp = jnp.pad(y.astype(jnp.float32), (0, R_pad - R))
+    wv = w.astype(jnp.float32) if has_weights else jnp.ones((R,), jnp.float32)
+    wp = jnp.pad(wv, (0, R_pad - R))
+    return _loss_pallas(
+        ints,
+        vals,
+        Xp.reshape(F * 8, C),
+        yp.reshape(8, C),
+        wp.reshape(8, C),
+        opset,
+        loss_elem,
+        n_slots,
+        P_TILE_LOSS,
+        C_TILE,
+        C,
+        R,
+    )
+
+
+def loss_trees_pallas_batch(flat: FlatTrees, X, y, weights, opset, loss_elem):
+    """Fused losses for a per-call row subset (minibatch scoring). X/y/weights
+    may be numpy or device arrays of the batch rows only."""
+    ints, vals = pack_flat_fused(flat, opset)
+    has_w = weights is not None
+    w = jnp.asarray(weights) if has_w else jnp.zeros((X.shape[-1],), jnp.float32)
+    return _loss_pallas_dyn(
+        ints,
+        vals,
+        jnp.asarray(X),
+        jnp.asarray(y),
+        w,
+        opset,
+        loss_elem,
+        flat.kind.shape[1],
+        has_w,
+        int(X.shape[-1]),
+    )
+
+
+def make_packed_loss_fn(X, y, weights, opset: OperatorSet, loss_elem, n_slots: int):
+    """Like make_pallas_loss_fn, but takes pre-packed slab arrays
+    (ops.flat.FlatSlab layout) — zero per-call host packing. Returns
+    ``fn(ints [P, L] int32, vals [P, Lv] f32) -> losses [P]``."""
+    Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+
+    def fn(ints, vals) -> jax.Array:
+        P = ints.shape[0]
+        if P % P_TILE_LOSS != 0:
+            raise ValueError(f"P={P} must be a multiple of {P_TILE_LOSS}")
+        return _loss_pallas(
+            jnp.asarray(ints),
+            jnp.asarray(vals),
+            Xr,
+            yr,
+            wr,
+            opset,
+            loss_elem,
+            n_slots,
+            P_TILE_LOSS,
+            C_TILE,
+            C,
+            R,
+        )
+
+    return fn
+
+
 _SUPPORT_CACHE: dict = {}
 
 
-def pallas_supported(opset: OperatorSet, n_features: int = 2) -> bool:
-    """Probe whether this operator set lowers through Mosaic (cached)."""
-    if jax.devices()[0].platform not in ("tpu",):
-        return False
-    if opset in _SUPPORT_CACHE:
-        return _SUPPORT_CACHE[opset]
+def pallas_supported(opset: OperatorSet, n_features: int = 2, loss_elem=None) -> bool:
+    """Probe whether the fused loss kernel lowers through Mosaic for this
+    (operator set, loss) — by COMPILING it, not by platform-string matching
+    (the TPU registers under the experimental 'axon' plugin on some hosts).
+    Cached per (opset, loss)."""
+    from .losses import L2DistLoss
+
+    loss_elem = loss_elem or L2DistLoss
+    if jax.devices()[0].platform == "cpu":
+        return False  # Mosaic needs a TPU; the scan interpreter is the CPU path
+    key = (opset, loss_elem)
+    if key in _SUPPORT_CACHE:
+        return _SUPPORT_CACHE[key]
     try:
         from .flat import flatten_trees
         from ..tree import binary, constant, feature, unary as unary_node
@@ -218,14 +593,15 @@ def pallas_supported(opset: OperatorSet, n_features: int = 2) -> bool:
         for i in range(opset.n_unary):
             t = unary_node(i, t)
         n_nodes = 1 + 2 * opset.n_binary + opset.n_unary
-        flat = flatten_trees([t] * 8, _round_up(n_nodes, 8))
+        flat = flatten_trees([t] * P_TILE_LOSS, _round_up(n_nodes, 8))
         X = np.ones((max(n_features, 1), 128), np.float32)
-        out = eval_trees_pallas(flat, X, opset)
+        y = np.ones((128,), np.float32)
+        out = loss_trees_pallas(flat, X, y, None, opset, loss_elem)
         out.block_until_ready()
-        _SUPPORT_CACHE[opset] = True
+        _SUPPORT_CACHE[key] = True
     except Exception as e:  # noqa: BLE001 — any lowering failure means fallback
         import warnings
 
         warnings.warn(f"Pallas eval unavailable for {opset}: {type(e).__name__}: {e}")
-        _SUPPORT_CACHE[opset] = False
-    return _SUPPORT_CACHE[opset]
+        _SUPPORT_CACHE[key] = False
+    return _SUPPORT_CACHE[key]

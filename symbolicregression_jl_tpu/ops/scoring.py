@@ -45,36 +45,44 @@ def batched_loss(
 ) -> jax.Array:
     """Losses for a batch of trees: [P]. inf where evaluation is invalid.
 
-    use_pallas selects the Mosaic kernel forward path (~900x the scan
-    interpreter on TPU at 10k rows); callers gate it on `pallas_supported`.
+    use_pallas selects the fused Mosaic loss kernel (eval + loss + reduction
+    in one pass, no [P, R] prediction matrix); callers gate it on
+    `pallas_supported`. The pallas branch does host-side packing, so it must
+    not be called under an outer jit — use batched_loss_jit or
+    make_pallas_loss_fn for hot loops.
     """
     if use_pallas:
-        from .interp_pallas import eval_trees_pallas
+        from .interp_pallas import loss_trees_pallas
 
-        preds = eval_trees_pallas(flat, X, opset)
-    else:
-        preds = eval_trees(flat, X, opset)
+        return loss_trees_pallas(flat, X, y, weights, opset, loss_elem)
+    preds = eval_trees(flat, X, opset)
     elem = loss_elem(preds, y[None, :])
     losses = weighted_mean_loss(elem, None if weights is None else weights[None, :])
     ok = jnp.isfinite(preds).all(axis=-1)
     return jnp.where(ok, losses, jnp.inf)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("opset", "loss_elem", "has_weights", "use_pallas")
-)
-def _batched_loss_jit(flat, X, y, weights, opset, loss_elem, has_weights, use_pallas):
+@functools.partial(jax.jit, static_argnames=("opset", "loss_elem", "has_weights"))
+def _batched_loss_jit(flat, X, y, weights, opset, loss_elem, has_weights):
     return batched_loss(
-        flat, X, y, weights if has_weights else None, opset, loss_elem, use_pallas
+        flat, X, y, weights if has_weights else None, opset, loss_elem, False
     )
 
 
 def batched_loss_jit(flat, X, y, weights, opset, loss_elem, use_pallas=False) -> jax.Array:
     """Jitted entry point; weights=None handled via a static flag so the
-    compiled program count stays O(1)."""
+    compiled program count stays O(1).
+
+    The pallas path re-packs the dataset into sublane layout on the HOST every
+    call (np.asarray on X — a device-to-host copy if X is device-resident,
+    which permanently degrades this backend's dispatch to sync mode). It is
+    for one-shot use only; hot loops MUST hold a make_pallas_loss_fn /
+    make_packed_loss_fn closure instead."""
+    if use_pallas:
+        return batched_loss(flat, X, y, weights, opset, loss_elem, True)
     has_weights = weights is not None
     w = weights if has_weights else jnp.zeros((), X.dtype)
-    return _batched_loss_jit(flat, X, y, w, opset, loss_elem, has_weights, use_pallas)
+    return _batched_loss_jit(flat, X, y, w, opset, loss_elem, has_weights)
 
 
 def loss_to_score(

@@ -37,5 +37,66 @@ def test_parity_with_scan_interpreter():
     got = np.asarray(eval_trees_pallas(flat, X, OPTS.operators))
     both_nan = np.isnan(want) & np.isnan(got)
     both_inf = np.isinf(want) & np.isinf(got)
-    ok = np.isclose(want, got, rtol=1e-4, atol=1e-4) | both_nan | both_inf
+    # rtol 1e-3: pow's Mosaic-safe kernel variant (exp*log formulation) rounds
+    # differently from XLA's pow by up to ~3e-4 relative in f32.
+    ok = np.isclose(want, got, rtol=1e-3, atol=1e-4) | both_nan | both_inf
     assert ok.mean() == 1.0, f"{(~ok).sum()} mismatches"
+
+
+def test_fused_loss_parity():
+    """Fused loss kernel (eval + loss + reduction in one Mosaic pass) vs the
+    unfused scan path, plain and weighted, non-tile-aligned rows."""
+    from symbolicregression_jl_tpu.ops.interp_pallas import make_pallas_loss_fn
+    from symbolicregression_jl_tpu.ops.scoring import batched_loss_jit
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(5, 777)).astype(np.float32)
+    y = np.cos(X[0]).astype(np.float32)
+    w = rng.uniform(0.5, 2.0, size=777).astype(np.float32)
+    trees = Population.random_trees(128, OPTS, 5, rng)
+    flat = flatten_trees(trees, OPTS.max_nodes)
+    for weights in (None, w):
+        got = np.asarray(
+            make_pallas_loss_fn(X, y, weights, OPTS.operators, OPTS.loss)(flat)
+        )
+        want = np.asarray(
+            batched_loss_jit(
+                flat,
+                jnp.asarray(X),
+                jnp.asarray(y),
+                None if weights is None else jnp.asarray(weights),
+                OPTS.operators,
+                OPTS.loss,
+                use_pallas=False,
+            )
+        )
+        assert (np.isinf(got) == np.isinf(want)).all()
+        fin = np.isfinite(got)
+        np.testing.assert_allclose(got[fin], want[fin], rtol=2e-4)
+
+
+def test_packed_slab_matches_flatten():
+    """FlatSlab rows fed to make_packed_loss_fn give the same losses as
+    flatten_trees + make_pallas_loss_fn."""
+    from symbolicregression_jl_tpu.ops.flat import FlatSlab
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        make_packed_loss_fn,
+        make_pallas_loss_fn,
+    )
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(3, 500)).astype(np.float32)
+    y = (X[0] * X[1]).astype(np.float32)
+    trees = Population.random_trees(64, OPTS, 3, rng)
+    flat = flatten_trees(trees, OPTS.max_nodes)
+    slab = FlatSlab(64, OPTS.max_nodes, OPTS.operators)
+    slab.set_trees(trees)
+    a = np.asarray(
+        make_packed_loss_fn(X, y, None, OPTS.operators, OPTS.loss, OPTS.max_nodes)(
+            slab.ints, slab.vals
+        )
+    )
+    b = np.asarray(make_pallas_loss_fn(X, y, None, OPTS.operators, OPTS.loss)(flat))
+    assert (np.isinf(a) == np.isinf(b)).all()
+    fin = np.isfinite(a)
+    np.testing.assert_allclose(a[fin], b[fin], rtol=1e-6)
