@@ -1,0 +1,139 @@
+"""Search-quality benchmark: BASELINE.md tracked configs 1 and 3.
+
+Config 1 — README low-level example: recover ``y = 2cos(x2) + x1^2 - 2`` from
+X = randn(2, 100) float32 (/root/reference/example.jl:1-27). Success bar =
+held-out residual < 1e-2, the reference's own accuracy budget
+(/root/reference/test/test_params.jl:8).
+
+Config 3 — the reference benchmark-suite config scaled to the north star:
+10k rows x 5 features, populations=100, population_size=100, maxsize=20,
+noisy non-recoverable target ``cos(2.13 x1) + 0.5 x2 |x3|^0.9 - 0.3 |x4|^1.5``
+(/root/reference/benchmark/benchmarks.jl:9-79). Reported as
+wall-clock-to-loss + the recovered Pareto front (no recovery bar: the target
+is outside the operator basis by construction).
+
+Scheduler: the device-resident engine on TPU, lockstep on CPU.
+Emits one JSON line per config plus a summary line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _pareto_rows(res, options):
+    return [
+        {
+            "complexity": r["complexity"],
+            "loss": round(float(r["loss"]), 6),
+            "score": round(float(r["score"]), 4),
+            "equation": r["equation"],
+        }
+        for r in res.report()
+    ]
+
+
+def config1(scheduler: str):
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 100)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    Xh = rng.normal(size=(2, 500)).astype(np.float32)  # held out
+    yh = 2 * np.cos(Xh[1]) + Xh[0] ** 2 - 2
+
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=20,
+        maxsize=20,
+        save_to_file=False,
+        seed=0,
+        scheduler=scheduler,
+    )
+    t0 = time.time()
+    res = equation_search(X, y, options=options, niterations=20, verbosity=0)
+    wall = time.time() - t0
+
+    # held-out residual of the best (lowest-loss) frontier member
+    best = min(res.pareto_frontier, key=lambda m: m.loss)
+    pred = best.tree.eval_np(Xh.astype(np.float64), options.operators)
+    resid = float(np.mean((pred - yh) ** 2))
+    return {
+        "config": "1_readme_example",
+        "scheduler": scheduler,
+        "wall_s": round(wall, 1),
+        "train_loss": round(float(best.loss), 8),
+        "holdout_mse": round(resid, 8),
+        "recovered": bool(resid < 1e-2),
+        "best_equation": best.tree.string_tree(options.operators),
+        "num_evals": round(res.num_evals, 0),
+        "pareto": _pareto_rows(res, options),
+    }
+
+
+def config3(scheduler: str, niterations: int = 12):
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, 10_000)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    # the reference benchmark adds 20% mult. noise; keep it deterministic here
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=100,
+        population_size=100,
+        ncycles_per_iteration=550,
+        maxsize=20,
+        save_to_file=False,
+        seed=0,
+        scheduler=scheduler,
+    )
+    t0 = time.time()
+    res = equation_search(X, y, options=options, niterations=niterations, verbosity=0)
+    wall = time.time() - t0
+    best = min(res.pareto_frontier, key=lambda m: m.loss)
+    return {
+        "config": "3_bench_10k_100x100",
+        "scheduler": scheduler,
+        "wall_s": round(wall, 1),
+        "best_loss": round(float(best.loss), 6),
+        "num_evals": round(res.num_evals, 0),
+        "evals_per_sec": round(res.num_evals / wall, 0),
+        "best_equation": best.tree.string_tree(options.operators),
+        "pareto": _pareto_rows(res, options),
+    }
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    scheduler = "device" if on_tpu else "lockstep"
+
+    r1 = config1(scheduler)
+    print(json.dumps(r1))
+    r3 = config3(scheduler, niterations=12 if on_tpu else 2)
+    print(json.dumps(r3))
+    print(
+        json.dumps(
+            {
+                "metric": "search_quality",
+                "config1_recovered": r1["recovered"],
+                "config1_wall_s": r1["wall_s"],
+                "config3_best_loss": r3["best_loss"],
+                "config3_wall_s": r3["wall_s"],
+                "scheduler": scheduler,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ batched XLA programs on TPU; the evolutionary control loop stays on the host.
 
 from .dataset import Dataset
 from .options import MutationWeights, Options
+from .regressor import MultitargetSRRegressor, SRRegressor
 from .search import SearchResult, equation_search
 from .tree import Node, binary, constant, feature, unary
 from .models.hall_of_fame import HallOfFame
@@ -29,7 +30,9 @@ __version__ = "0.1.0"
 __all__ = [
     "Dataset",
     "MutationWeights",
+    "MultitargetSRRegressor",
     "Options",
+    "SRRegressor",
     "SearchResult",
     "equation_search",
     "Node",

@@ -1,0 +1,523 @@
+"""Driver for the device-resident evolution engine (Options.scheduler="device").
+
+Host responsibilities shrink to: build config, upload the dataset and initial
+populations ONCE, dispatch one compiled program per iteration
+(ops/evolve.run_iteration + the batched constant optimizer), read back ONE
+packed array per iteration for the hall of fame / stop conditions, and decode
+final populations at the end. Everything else — tournament, mutation,
+crossover, accept, replacement, frequencies, migration — happens on device
+(see ops/evolve.py for reference-semantics citations).
+
+Transfer discipline (measured; bench.py module docstring): after the first
+device-to-host copy this backend permanently charges ~12ms per dispatch and
+~100ms fixed per host-to-device transfer. Hence: no per-iteration H2D at all
+(even the warmup-maxsize scalar lives in device state), and all per-iteration
+readbacks are packed into a single f32 array.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..dataset import Dataset
+from ..options import Options
+from ..ops.evolve import EvoConfig, EvoState, _score_of, init_state, run_iteration
+from ..ops.flat import KIND_CONST, FlatTrees, flatten_trees, unflatten_tree
+from ..ops.treeops import Tree
+from .hall_of_fame import HallOfFame
+from .pop_member import PopMember
+from .population import Population
+
+__all__ = ["device_search_one_output", "device_mode_supported"]
+
+
+def device_mode_supported(options: Options) -> str | None:
+    """None if the device engine can honor this configuration; else a reason
+    string (callers fall back to the host lockstep engine or raise)."""
+    if options.loss_function is not None:
+        return "custom full-objective loss_function"
+    if options.complexity_mapping is not None:
+        return "custom complexity mapping"
+    bin_caps, una_caps = options.op_constraints
+    if any(c != (-1, -1) for c in bin_caps) or any(c != -1 for c in una_caps):
+        return "per-operator size constraints"
+    if options.nested_constraints_resolved:
+        return "nested operator constraints"
+    if options.batching:
+        return "minibatching"
+    if options.data_sharding is not None:
+        return "dataset row sharding"
+    if np.dtype(options.dtype) != np.float32:
+        return "non-float32 compute dtype"
+    return None
+
+
+def _make_score_fn(X, y, weights, options: Options, use_pallas: bool):
+    """Build the in-graph scoring closure: batched Tree arrays [B, N] ->
+    losses [B]. Built ONCE per search (stable identity = stable jit cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    opset, loss_elem = options.operators, options.loss
+    N = options.max_nodes
+
+    if use_pallas:
+        from ..ops.interp_pallas import (
+            C_TILE,
+            P_TILE_LOSS,
+            _loss_pallas,
+            _reshape_rows,
+            _round_up,
+            pack_batch_jnp,
+        )
+
+        Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+        Lv = _round_up(N, 128)
+
+        def score_fn(batch):
+            B = batch.kind.shape[0]
+            B_pad = _round_up(B, P_TILE_LOSS)
+            ints = pack_batch_jnp(
+                batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat,
+                batch.length, opset,
+            )
+            vals = jnp.pad(batch.val.astype(jnp.float32), ((0, 0), (0, Lv - N)))
+            if B_pad != B:  # pad with copies of row 0 (must be a VALID tree)
+                ints = jnp.concatenate(
+                    [ints, jnp.broadcast_to(ints[:1], (B_pad - B, ints.shape[1]))],
+                    axis=0,
+                )
+                vals = jnp.concatenate(
+                    [vals, jnp.broadcast_to(vals[:1], (B_pad - B, Lv))], axis=0
+                )
+            out = _loss_pallas(
+                ints, vals, Xr, yr, wr, opset, loss_elem,
+                N, P_TILE_LOSS, C_TILE, C, R,
+            )
+            return out[:B]
+
+        return score_fn
+
+    # scan-interpreter fallback (CPU tests, non-lowerable operator sets)
+    from ..ops.interp import eval_trees
+    from ..ops.losses import weighted_mean_loss
+
+    Xd = jnp.asarray(X, jnp.float32)
+    yd = jnp.asarray(y, jnp.float32)
+    wd = None if weights is None else jnp.asarray(weights, jnp.float32)
+
+    def score_fn(batch):
+        flat = FlatTrees(
+            batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat,
+            batch.val.astype(jnp.float32), batch.length,
+        )
+        preds = eval_trees(flat, Xd, opset)
+        elem = loss_elem(preds, yd[None, :])
+        losses = weighted_mean_loss(elem, None if wd is None else wd[None, :])
+        ok = jnp.isfinite(preds).all(axis=-1)
+        return jnp.where(ok, losses, jnp.inf)
+
+    return score_fn
+
+
+def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig):
+    """Jitted per-iteration constant optimization over a fixed-size random
+    member subset, fully device-side (selection, BFGS, accept, scatter-back).
+    Reference semantics: optimize with prob optimizer_probability per member,
+    accept if improved, reset birth
+    (/root/reference/src/ConstantOptimization.jl:11-83)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.constant_opt import _bfgs_single, _tree_loss_fn
+    from ..ops.interp import _Structure
+
+    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    # fixed-size subset (jit needs static shapes): expected count under the
+    # reference's Bernoulli(p) selection
+    K = max(1, int(round(options.optimizer_probability * I * P)))
+    S = 1 + options.optimizer_nrestarts
+    iters = int(options.optimizer_iterations)
+    opset, loss_elem = options.operators, options.loss
+    # chunk the BFGS batch: with jax.checkpoint (below) each instance holds
+    # ~[N, R] registers fwd + recomputed bwd; budget ~500MB per chunk
+    import os
+
+    # Empirically tuned (10k rows, 7 ops): chunk 8 is fastest AND safe; larger
+    # chunks both slow down (vmapped backtracking line search pays the worst
+    # lane's halvings) and can fault the device at >=32. The deeper fix is a
+    # Pallas backward kernel for d(loss)/d(constants); until then the scan
+    # interpreter + remat carries the BFGS inner loop.
+    chunk = int(os.environ.get("SR_CONSTOPT_CHUNK", 8))
+    chunk = min(chunk, K, I * P)
+    n_chunks = min(-(-K // chunk), (I * P) // chunk)
+    K = n_chunks * chunk
+
+    Xd = jnp.asarray(X, jnp.float32)
+    yd = jnp.asarray(y, jnp.float32)
+    has_w = weights is not None
+    wd = jnp.asarray(weights, jnp.float32) if has_w else jnp.zeros((), jnp.float32)
+    _base_loss = _tree_loss_fn(opset, loss_elem)
+    # remat: recompute the interpreter in the backward pass instead of saving
+    # per-branch residuals — trades ~2x FLOPs for ~n_ops x less live memory,
+    # which is what bounds the BFGS batch size here
+    _ck = jax.checkpoint(lambda v, s: _base_loss(v, s, Xd, yd, wd, has_w))
+
+    def loss_fn(v, s, X_, y_, w_, hw_):
+        return _ck(v, s)
+
+    @jax.jit
+    def const_opt(state: EvoState) -> EvoState:
+        key, k_sel, k_jit = jax.random.split(state.key, 3)
+        # K distinct member slots out of I*P
+        flat_idx = jax.random.permutation(k_sel, I * P)[:K]
+        ii, pp = flat_idx // P, flat_idx % P
+
+        def field(a):
+            return a[ii, pp]
+
+        kind = field(state.kind)
+        structure = _Structure(
+            kind, field(state.op), field(state.lhs), field(state.rhs),
+            field(state.feat), field(state.length),
+        )
+        val0 = field(state.val).astype(jnp.float32)
+        mask = kind == KIND_CONST
+        jitter = 1.0 + 0.5 * jax.random.normal(k_jit, (K, S - 1, N))
+        starts = jnp.concatenate(
+            [val0[:, None, :], val0[:, None, :] * jitter], axis=1
+        )
+
+        def per_tree(struct_p, starts_p, mask_p):
+            def per_restart(v0):
+                return _bfgs_single(
+                    loss_fn, v0, struct_p, Xd, yd, wd, has_w, mask_p, iters
+                )
+
+            vals, fs = jax.vmap(per_restart)(starts_p)
+            fs = jnp.where(jnp.isfinite(fs), fs, jnp.inf)
+            best = jnp.argmin(fs)
+            return vals[best], fs[best]
+
+        def per_chunk(args):
+            return jax.vmap(per_tree)(*args)
+
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]),
+            (structure, starts, mask),
+        )
+        vals, fs = lax.map(per_chunk, chunked)
+        vals = vals.reshape((K,) + vals.shape[2:])
+        fs = fs.reshape((K,))
+        old_loss = state.loss[ii, pp]
+        has_consts = jnp.any(mask, axis=1)
+        improved = (fs < old_loss) & has_consts
+        new_val = jnp.where(improved[:, None], vals, val0)
+        new_loss = jnp.where(improved, fs, old_loss)
+        comp = state.length[ii, pp].astype(jnp.float32)
+        new_score = _score_of(new_loss, comp, cfg)
+        n_evals = jnp.asarray(K * S * 2 * iters, jnp.float32)
+        return state._replace(
+            val=state.val.at[ii, pp].set(new_val),
+            loss=state.loss.at[ii, pp].set(new_loss),
+            score=state.score.at[ii, pp].set(new_score),
+            birth=state.birth.at[ii, pp].set(
+                jnp.where(improved, state.step, state.birth[ii, pp])
+            ),
+            key=key,
+            num_evals=state.num_evals + n_evals,
+        )
+
+    return const_opt
+
+
+def _make_readback_fn(cfg: EvoConfig):
+    """Jitted packer: best-seen hall of fame + counters -> ONE f32 array."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pack(state: EvoState):
+        S1 = cfg.maxsize + 1
+        parts = [
+            state.bs_loss,
+            state.bs_exists.astype(jnp.float32),
+            state.bs_tree[6].astype(jnp.float32),  # lengths
+        ]
+        for f in state.bs_tree[:6]:
+            parts.append(f.astype(jnp.float32).reshape(-1))
+        parts.append(state.num_evals[None])
+        parts.append(state.step.astype(jnp.float32)[None])
+        return jnp.concatenate([p.reshape(-1) for p in parts])
+
+    return pack
+
+
+def _decode_readback(buf: np.ndarray, cfg: EvoConfig):
+    S1 = cfg.maxsize + 1
+    N = cfg.n_slots
+    off = 0
+
+    def take(n):
+        nonlocal off
+        out = buf[off : off + n]
+        off += n
+        return out
+
+    bs_loss = take(S1)
+    bs_exists = take(S1) > 0.5
+    bs_len = take(S1).astype(np.int32)
+    fields = [take(S1 * N).reshape(S1, N) for _ in range(6)]
+    num_evals = float(take(1)[0])
+    return bs_loss, bs_exists, bs_len, fields, num_evals
+
+
+def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
+    """Decode best-seen rows into host PopMembers."""
+    members = []
+    kind, op, lhs, rhs, feat, val = fields
+    flat = FlatTrees(
+        kind.astype(np.int32), op.astype(np.int32), lhs.astype(np.int32),
+        rhs.astype(np.int32), feat.astype(np.int32), val.astype(np.float32),
+        bs_len,
+    )
+    for s in range(len(bs_loss)):
+        if not bs_exists[s] or bs_len[s] < 1:
+            continue
+        tree = unflatten_tree(flat, s)
+        loss = float(bs_loss[s])
+        score = float(_score_of(loss, float(bs_len[s]), cfg))
+        m = PopMember(tree, score, loss, complexity=int(bs_len[s]))
+        members.append(m)
+    return members
+
+
+def device_search_one_output(
+    dataset: Dataset,
+    options: Options,
+    niterations: int,
+    rng: np.random.Generator,
+    saved_state=None,
+    verbosity: int = 1,
+    output_file: str | None = None,
+):
+    """Run one output's search on the device engine. Returns SearchResult
+    (same contract as models/../search._search_one_output)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..search import SearchResult  # late import (module cycle)
+    from ..utils.export_csv import save_hall_of_fame
+
+    reason = device_mode_supported(options)
+    if reason is not None:
+        raise ValueError(
+            f"scheduler='device' cannot honor this configuration ({reason}); "
+            "use scheduler='lockstep'"
+        )
+
+    I, P = options.populations, options.population_size
+    N = options.max_nodes
+    X = dataset.X.astype(np.float32)
+    y = dataset.y.astype(np.float32)
+    w = None if dataset.weights is None else dataset.weights.astype(np.float32)
+
+    # --- baseline loss ON DEVICE (no readback; becomes a program constant) --
+    # Reference: update_baseline_loss!, /root/reference/src/LossFunctions.jl:201-215.
+    # The value is folded into score arithmetic host-side only at decode time;
+    # for cfg we need a concrete float, so compute it from numpy directly
+    # (cheap, no device round-trip).
+    avg = dataset.avg_y
+    elem = np.asarray(options.loss(np.full_like(y, avg), y), np.float64)
+    if w is not None:
+        bl = float((elem * w).sum() / w.sum())
+    else:
+        bl = float(elem.mean())
+    use_baseline = bool(np.isfinite(bl))
+    dataset.baseline_loss = bl if use_baseline else 1.0
+    dataset.use_baseline = use_baseline
+
+    mw = options.mutation_weights
+    cfg = EvoConfig(
+        n_islands=I,
+        pop_size=P,
+        n_slots=N,
+        maxsize=options.maxsize,
+        maxdepth=options.maxdepth,
+        nfeatures=dataset.n_features,
+        n_unary=options.operators.n_unary,
+        n_binary=options.operators.n_binary,
+        tournament_n=min(options.tournament_selection_n, P),
+        tournament_weights=tuple(
+            np.asarray(options.tournament_weights)[: min(options.tournament_selection_n, P)]
+            / np.asarray(options.tournament_weights)[: min(options.tournament_selection_n, P)].sum()
+        ),
+        mutation_weights=(
+            mw.mutate_constant,
+            mw.mutate_operator,
+            mw.swap_operands,
+            mw.add_node,
+            mw.insert_node,
+            mw.delete_node,
+            mw.randomize,
+            mw.do_nothing,
+        ),
+        crossover_probability=options.crossover_probability,
+        annealing=options.annealing,
+        alpha=options.alpha,
+        parsimony=options.parsimony,
+        use_frequency=options.use_frequency,
+        use_frequency_in_tournament=options.use_frequency_in_tournament,
+        adaptive_parsimony_scaling=options.adaptive_parsimony_scaling,
+        perturbation_factor=options.perturbation_factor,
+        probability_negate_constant=options.probability_negate_constant,
+        baseline_loss=dataset.baseline_loss,
+        use_baseline=use_baseline,
+        ncycles=options.ncycles_per_iteration,
+        events_per_cycle=max(1, -(-P // min(options.tournament_selection_n, P))),
+        fraction_replaced=options.fraction_replaced,
+        fraction_replaced_hof=options.fraction_replaced_hof,
+        migration=options.migration,
+        hof_migration=options.hof_migration,
+        topn=min(options.topn, P),
+        niterations=niterations,
+        warmup_maxsize_by=options.warmup_maxsize_by,
+    )
+
+    use_pallas = jax.devices()[0].platform != "cpu"
+    if use_pallas:
+        from ..ops.interp_pallas import pallas_supported
+
+        use_pallas = pallas_supported(
+            options.operators, dataset.n_features, options.loss
+        )
+    score_fn = _make_score_fn(X, y, w, options, use_pallas)
+    const_opt_fn = (
+        _make_const_opt_fn(X, y, w, options, cfg)
+        if options.should_optimize_constants
+        else None
+    )
+    readback_fn = _make_readback_fn(cfg)
+
+    # --- initial populations (host trees -> device state) -------------------
+    if saved_state is not None:
+        init_trees = [
+            m.tree for pop in saved_state.populations for m in pop.members
+        ][: I * P]
+        if len(init_trees) < I * P:
+            init_trees.extend(
+                Population.random_trees(
+                    I * P - len(init_trees), options, dataset.n_features, rng
+                )
+            )
+    else:
+        init_trees = Population.random_trees(I * P, options, dataset.n_features, rng)
+    flat = flatten_trees(init_trees, N)
+
+    # score initial members on device (stay async: losses remain on device)
+    batch0 = Tree(
+        jnp.asarray(flat.kind), jnp.asarray(flat.op), jnp.asarray(flat.lhs),
+        jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
+        jnp.asarray(flat.length),
+    )
+    init_losses = jax.jit(score_fn)(batch0)
+
+    seed = int(rng.integers(0, 2**31 - 1))
+    state = init_state(flat, np.zeros(I * P), cfg, seed)
+    # overwrite host-zero losses with the device-computed ones (keeps the
+    # whole init path free of device->host copies)
+    comp = state.length.astype(jnp.float32)
+    loss_dev = init_losses.reshape(I, P)
+    state = state._replace(loss=loss_dev, score=_score_of(loss_dev, comp, cfg))
+
+    hof = HallOfFame(options.maxsize)
+    if saved_state is not None:
+        # seed from the saved hall of fame (reference warm start re-ingests it,
+        # /root/reference/src/SymbolicRegression.jl:727-744; dataset unchanged
+        # here so stored losses remain valid)
+        for m in saved_state.hall_of_fame.members:
+            if m is not None:
+                hof.update(m, options)
+    early_stop = options.early_stop_fn()
+    start_time = time.time()
+    stop_reason = None
+    num_evals = 0.0
+
+    for it in range(niterations):
+        state = run_iteration(state, cfg, score_fn)
+        if const_opt_fn is not None:
+            state = const_opt_fn(state)
+        buf = np.asarray(readback_fn(state))  # the iteration's ONE readback
+        bs_loss, bs_exists, bs_len, fields, num_evals = _decode_readback(buf, cfg)
+        for m in _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg, options):
+            hof.update(m, options)
+
+        if output_file and options.save_to_file:
+            save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+        if verbosity > 0:
+            elapsed = time.time() - start_time
+            print(
+                f"[device iter {it + 1}/{niterations}] evals={num_evals:.3g} "
+                f"elapsed={elapsed:.1f}s evals/s={num_evals / max(elapsed, 1e-9):.3g}"
+            )
+            print(hof.render(options, dataset.variable_names))
+
+        if early_stop is not None and any(
+            early_stop(m.loss, m.get_complexity(options))
+            for m in hof.pareto_frontier()
+        ):
+            stop_reason = "early_stop"
+            break
+        if (
+            options.timeout_in_seconds is not None
+            and time.time() - start_time > options.timeout_in_seconds
+        ):
+            stop_reason = "timeout"
+            break
+        if options.max_evals is not None and num_evals >= options.max_evals:
+            stop_reason = "max_evals"
+            break
+
+    # --- final population readback (host Populations for warm starts) -------
+    def np_at(a):
+        return np.asarray(a)
+
+    kind = np_at(state.kind)
+    opa = np_at(state.op)
+    lhs = np_at(state.lhs)
+    rhs = np_at(state.rhs)
+    feat = np_at(state.feat)
+    val = np_at(state.val)
+    length = np_at(state.length)
+    loss = np_at(state.loss).astype(np.float64)
+    score = np_at(state.score).astype(np.float64)
+    pops = []
+    for i in range(I):
+        flat_i = FlatTrees(
+            kind[i], opa[i], lhs[i], rhs[i], feat[i], val[i], length[i]
+        )
+        members = []
+        for p in range(P):
+            if length[i, p] < 1:
+                continue
+            tree = unflatten_tree(flat_i, p)
+            m = PopMember(
+                tree, float(score[i, p]), float(loss[i, p]),
+                complexity=int(length[i, p]),
+            )
+            members.append(m)
+            hof.update(m, options)
+        pops.append(Population(members))
+
+    result = SearchResult(
+        hall_of_fame=hof,
+        populations=pops,
+        dataset=dataset,
+        options=options,
+        num_evals=num_evals,
+    )
+    result.stop_reason = stop_reason
+    return result

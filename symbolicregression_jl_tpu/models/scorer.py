@@ -14,6 +14,8 @@ compiles a handful of programs, all cached.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..dataset import Dataset
@@ -62,6 +64,8 @@ class BatchScorer:
         dataset.baseline_loss = bl
         dataset.use_baseline = use
         self.num_evals = 0.0
+        # the async island scheduler scores from worker threads
+        self._evals_lock = threading.Lock()
 
     def _setup_row_sharding(self) -> None:
         """Shard the dataset rows across all devices and route full-data
@@ -112,12 +116,14 @@ class BatchScorer:
         flat = flatten_trees(padded, self.max_nodes, dtype=self.dtype)
         if idx is None:
             X, y, w = self.X, self.y, self.w
-            self.num_evals += P
+            with self._evals_lock:
+                self.num_evals += P
         else:
             X = self.X[:, idx]
             y = self.y[idx]
             w = None if self.w is None else self.w[idx]
-            self.num_evals += P * (len(idx) / self.dataset.n)
+            with self._evals_lock:
+                self.num_evals += P * (len(idx) / self.dataset.n)
         if self._sharded is not None and idx is None:
             import jax.numpy as jnp
 

@@ -1,0 +1,832 @@
+"""Device-resident regularized evolution — the whole hot loop in one program.
+
+Motivation (measured on the tunneled-TPU backend, see bench.py): the FIRST
+device-to-host copy permanently drops the client to synchronous dispatch
+(~12ms/call) with ~100ms fixed cost per host-to-device transfer. A host-driven
+evolution loop therefore pays ~100ms+ per scoring cycle no matter how fast the
+kernel is. This module keeps populations, tournament selection, mutation,
+crossover, the Metropolis accept rule, replacement, frequency statistics and
+migration ALL on device: one jitted program advances every island through a
+full iteration (ncycles x events), and the host reads back state once per
+iteration.
+
+Reference semantics being reproduced (with citations):
+- tournament + geometric rank pick: /root/reference/src/Population.jl:103-160
+- mutation weight conditioning: /root/reference/src/Mutate.jl:34-76
+- mutation kinds: /root/reference/src/MutationFunctions.jl
+- Metropolis accept (annealing x parsimony frequency ratio):
+  /root/reference/src/Mutate.jl:276-317
+- replace-oldest regularized evolution: /root/reference/src/RegularizedEvolution.jl:14-109
+- crossover: /root/reference/src/Mutate.jl:361-429, crossover_trees
+  /root/reference/src/MutationFunctions.jl:271-303
+- adaptive parsimony histogram: /root/reference/src/AdaptiveParsimony.jl:20-95
+- migration: /root/reference/src/Migration.jl:16-38
+
+Deliberate deviations (documented for the parity suite):
+- one mutation attempt per event with fall-back-to-skip instead of <=10
+  retries (skip_mutation_failures semantics, /root/reference/src/Mutate.jl:247-266);
+- `simplify` and `optimize` mutations are handled at iteration boundaries
+  (constant optimization) or not at all (algebraic simplify) instead of
+  in-cycle;
+- migration replaces members by independent Bernoulli(frac) draws rather than
+  a Poisson-sampled count (same mean).
+Complexity = node count (the reference default); custom complexity mappings,
+per-operator constraints and custom objectives route to the host engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .flat import KIND_BINARY, KIND_CONST, KIND_PAD, KIND_UNARY, KIND_VAR
+from .treeops import (
+    Tree,
+    extract_block,
+    random_tree,
+    replace_range,
+    subtree_sizes,
+    tree_depth,
+)
+
+__all__ = ["EvoConfig", "EvoState", "init_state", "run_iteration"]
+
+
+# Mutation kind indices for the device switch (subset of the reference's 12;
+# see module docstring for how simplify/optimize/connections are handled).
+M_CONST, M_OPERATOR, M_SWAP, M_ADD, M_INSERT, M_DELETE, M_RANDOMIZE, M_NOTHING = range(8)
+
+
+@dataclasses.dataclass(frozen=True)
+class EvoConfig:
+    """Static (hashable) engine configuration — a jit static argument."""
+
+    n_islands: int
+    pop_size: int
+    n_slots: int
+    maxsize: int
+    maxdepth: int
+    nfeatures: int
+    n_unary: int
+    n_binary: int
+    tournament_n: int
+    tournament_weights: tuple  # geometric rank weights, len tournament_n
+    mutation_weights: tuple  # 8 floats, M_* order
+    crossover_probability: float
+    annealing: bool
+    alpha: float
+    parsimony: float
+    use_frequency: bool
+    use_frequency_in_tournament: bool
+    adaptive_parsimony_scaling: float
+    perturbation_factor: float
+    probability_negate_constant: float
+    baseline_loss: float
+    use_baseline: bool
+    ncycles: int
+    events_per_cycle: int
+    fraction_replaced: float
+    fraction_replaced_hof: float
+    migration: bool
+    hof_migration: bool
+    topn: int
+    niterations: int
+    warmup_maxsize_by: float
+
+
+class EvoState(NamedTuple):
+    """All mutable search state, device-resident. Tree arrays are [I, P, N]
+    (islands x members x slots); per-member scalars are [I, P]."""
+
+    kind: jax.Array
+    op: jax.Array
+    lhs: jax.Array
+    rhs: jax.Array
+    feat: jax.Array
+    val: jax.Array
+    length: jax.Array  # int32 [I, P]
+    loss: jax.Array  # float32 [I, P]
+    score: jax.Array  # float32 [I, P]
+    birth: jax.Array  # int32 [I, P]
+    freq: jax.Array  # float32 [S+1] complexity histogram (shared, lockstep)
+    bs_loss: jax.Array  # float32 [S+1] best-seen loss per complexity
+    bs_tree: tuple  # Tree-field arrays [S+1, N] (+ length [S+1]) of best-seen
+    bs_exists: jax.Array  # bool [S+1]
+    key: jax.Array
+    step: jax.Array  # int32 event counter (birth clock)
+    num_evals: jax.Array  # float32
+    iteration: jax.Array  # int32 — drives the on-device warmup-maxsize schedule
+
+
+def _member_tree(state: EvoState, i, p) -> Tree:
+    return Tree(
+        state.kind[i, p],
+        state.op[i, p],
+        state.lhs[i, p],
+        state.rhs[i, p],
+        state.feat[i, p],
+        state.val[i, p],
+        state.length[i, p],
+    )
+
+
+def _score_of(loss, complexity, cfg: EvoConfig):
+    """loss_to_score (/root/reference/src/LossFunctions.jl:138-158)."""
+    norm = cfg.baseline_loss if (cfg.use_baseline and cfg.baseline_loss >= 0.01) else 0.01
+    return loss / norm + complexity * cfg.parsimony
+
+
+def init_state(
+    flat_arrays, losses, cfg: EvoConfig, seed: int, freq_init=None
+) -> EvoState:
+    """Build device state from host-flattened populations.
+
+    flat_arrays: FlatTrees-style tuple with shapes [I*P, N] / [I*P]
+    losses: [I*P] float64/32 host losses (already scored)."""
+    I, P, N, S = cfg.n_islands, cfg.pop_size, cfg.n_slots, cfg.maxsize
+
+    def r(a, dtype):
+        return jnp.asarray(np.asarray(a), dtype).reshape(I, P, *np.shape(a)[1:])
+
+    kind = r(flat_arrays.kind, jnp.int32)
+    op = r(flat_arrays.op, jnp.int32)
+    lhs = r(flat_arrays.lhs, jnp.int32)
+    rhs = r(flat_arrays.rhs, jnp.int32)
+    feat = r(flat_arrays.feat, jnp.int32)
+    val = r(flat_arrays.val, jnp.float32)
+    length = jnp.asarray(np.asarray(flat_arrays.length), jnp.int32).reshape(I, P)
+    loss = jnp.asarray(np.asarray(losses), jnp.float32).reshape(I, P)
+    comp = length.astype(jnp.float32)
+    score = _score_of(loss, comp, cfg)
+    freq = (
+        jnp.asarray(freq_init, jnp.float32)
+        if freq_init is not None
+        else jnp.ones((S + 1,), jnp.float32)
+    )
+    bs_tree = (
+        jnp.zeros((S + 1, N), jnp.int32),  # kind
+        jnp.zeros((S + 1, N), jnp.int32),  # op
+        jnp.zeros((S + 1, N), jnp.int32),  # lhs
+        jnp.zeros((S + 1, N), jnp.int32),  # rhs
+        jnp.zeros((S + 1, N), jnp.int32),  # feat
+        jnp.zeros((S + 1, N), jnp.float32),  # val
+        jnp.zeros((S + 1,), jnp.int32),  # length
+    )
+    return EvoState(
+        kind,
+        op,
+        lhs,
+        rhs,
+        feat,
+        val,
+        length,
+        loss,
+        score,
+        birth=jnp.tile(jnp.arange(P, dtype=jnp.int32)[None], (I, 1)),
+        freq=freq,
+        bs_loss=jnp.full((S + 1,), jnp.inf, jnp.float32),
+        bs_tree=bs_tree,
+        bs_exists=jnp.zeros((S + 1,), bool),
+        key=jax.random.PRNGKey(seed),
+        step=jnp.asarray(P, jnp.int32),
+        num_evals=jnp.zeros((), jnp.float32),
+        iteration=jnp.zeros((), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tournament selection (vmapped over islands)
+# ---------------------------------------------------------------------------
+
+
+def _tournament(key, score, length, freq, cfg: EvoConfig):
+    """Winner index in [0, P) for ONE island.
+    Reference: best_of_sample, /root/reference/src/Population.jl:110-160."""
+    P = cfg.pop_size
+    n = cfg.tournament_n
+    k1, k2 = jax.random.split(key)
+    # n distinct members via random-key argsort
+    order = jnp.argsort(jax.random.uniform(k1, (P,)))
+    cand = order[:n]
+    s = score[cand]
+    if cfg.use_frequency_in_tournament:
+        fnorm = freq / jnp.maximum(jnp.sum(freq), 1e-30)
+        sizes = jnp.clip(length[cand], 0, cfg.maxsize)
+        s = s * jnp.exp(cfg.adaptive_parsimony_scaling * fnorm[sizes])
+    rank = jax.random.choice(
+        k2, n, p=jnp.asarray(cfg.tournament_weights, jnp.float32)
+    )
+    by_score = jnp.argsort(s)
+    return cand[by_score[rank]]
+
+
+# ---------------------------------------------------------------------------
+# Mutations (single tree; vmapped over islands)
+# ---------------------------------------------------------------------------
+
+
+def _rand_node(key, length):
+    return jax.random.randint(key, (), 0, jnp.maximum(length, 1))
+
+
+def _mutate_constant(key, tree: Tree, cfg: EvoConfig, temperature) -> Tree:
+    """Multiply or divide one random constant by maxChange^U(0,1) with
+    maxChange = perturbation_factor * T + 1.1, maybe negate — matching the
+    host engine (models/mutation_functions.py:77-99) and
+    /root/reference/src/MutationFunctions.jl:60-89."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    is_c = tree.kind == KIND_CONST
+    n_c = jnp.sum(is_c)
+    # index of a random constant slot
+    ranks = jnp.cumsum(is_c.astype(jnp.int32)) - 1
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_c, 1))
+    slot_hits = is_c & (ranks == pick)
+    max_change = cfg.perturbation_factor * temperature + 1.0 + 0.1
+    factor = max_change ** jax.random.uniform(k2, ())
+    factor = jnp.where(jax.random.uniform(k4, ()) < 0.5, factor, 1.0 / factor)
+    neg = jax.random.uniform(k3, ()) < cfg.probability_negate_constant
+    newval = tree.val * jnp.where(slot_hits, factor * jnp.where(neg, -1.0, 1.0), 1.0)
+    return tree._replace(val=jnp.where(n_c > 0, newval, tree.val))
+
+
+def _mutate_operator(key, tree: Tree, cfg: EvoConfig) -> Tree:
+    """Swap one operator for a random same-arity operator
+    (/root/reference/src/MutationFunctions.jl:44-57)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    is_op = tree.kind >= KIND_UNARY
+    n_op = jnp.sum(is_op)
+    ranks = jnp.cumsum(is_op.astype(jnp.int32)) - 1
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1))
+    hits = is_op & (ranks == pick)
+    new_un = jax.random.randint(k2, (), 0, max(cfg.n_unary, 1))
+    new_bin = jax.random.randint(k3, (), 0, max(cfg.n_binary, 1))
+    new_op = jnp.where(tree.kind == KIND_UNARY, new_un, new_bin)
+    return tree._replace(op=jnp.where(hits & (n_op > 0), new_op, tree.op))
+
+
+def _swap_operands(key, tree: Tree, cfg: EvoConfig) -> Tree:
+    """Swap the child subtrees of one random binary node
+    (/root/reference/src/MutationFunctions.jl:34-41)."""
+    N = tree.n_slots
+    k1 = key
+    is_bin = tree.kind == KIND_BINARY
+    n_b = jnp.sum(is_bin)
+    ranks = jnp.cumsum(is_bin.astype(jnp.int32)) - 1
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_b, 1))
+    p = jnp.argmax(is_bin & (ranks == pick))  # slot of chosen binary node
+    sizes = subtree_sizes(tree)
+    # children blocks: A = left subtree, B = right subtree; B ends at p-1
+    r_root = tree.rhs[p]
+    l_root = tree.lhs[p]
+    lenB = sizes[r_root]
+    lenA = sizes[l_root]
+    al = l_root - lenA + 1  # A = [al, al+lenA), B = [al+lenA, p)
+    j = lax.iota(jnp.int32, N)
+    inA = (j >= al) & (j < al + lenA)
+    inB = (j >= al + lenA) & (j < p)
+    # new layout: B first (shift left by lenA), then A (shift right by lenB)
+    src = jnp.clip(jnp.where(j < al + lenB, j + lenA, j - lenB), 0, N - 1)
+    use_move = (j >= al) & (j < p)
+
+    def mv(arr):
+        return jnp.where(use_move, arr[src], arr)
+
+    def mv_ptr(arr):
+        c = arr[src]
+        cin_a = (c >= al) & (c < al + lenA)
+        c2 = jnp.where(cin_a, c + lenB, jnp.where((c >= al + lenA) & (c < p), c - lenA, c))
+        return jnp.where(use_move, c2, arr)
+
+    kind = mv(tree.kind)
+    new = tree._replace(
+        kind=kind,
+        op=mv(tree.op),
+        lhs=jnp.where(kind >= KIND_UNARY, mv_ptr(tree.lhs), 0),
+        rhs=jnp.where(kind == KIND_BINARY, mv_ptr(tree.rhs), 0),
+        feat=mv(tree.feat),
+        val=jnp.where(use_move, tree.val[src], tree.val),
+    )
+    # fix the chosen node's own child pointers (it did not move)
+    new_lhs = new.lhs.at[p].set(al + lenB - 1)  # old B root, now first block
+    new_rhs = new.rhs.at[p].set(p - 1)  # old A root, now second block
+    new = new._replace(lhs=new_lhs, rhs=new_rhs)
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(n_b > 0, a, b), new, tree
+    )
+
+
+def _leaf_material(key, cfg: EvoConfig, n_slots: int) -> Tree:
+    """One random leaf (50/50 const/feature) as a 1-node block."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    is_const = jax.random.uniform(k1, ()) < 0.5
+    if cfg.nfeatures <= 0:
+        is_const = jnp.asarray(True)
+    N = n_slots
+    z = jnp.zeros((N,), jnp.int32)
+    kind = z.at[0].set(jnp.where(is_const, KIND_CONST, KIND_VAR))
+    feat = z.at[0].set(jax.random.randint(k2, (), 0, max(cfg.nfeatures, 1)))
+    val = jnp.zeros((N,), jnp.float32).at[0].set(jax.random.normal(k3, ()))
+    return Tree(kind, z, z, z, feat, val, jnp.asarray(1, jnp.int32))
+
+
+def _add_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
+    """append_random_op: replace a random LEAF with a random depth-1 operator
+    subtree (/root/reference/src/MutationFunctions.jl:92-121)."""
+    N = tree.n_slots
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    is_leaf = (tree.kind == KIND_CONST) | (tree.kind == KIND_VAR)
+    n_l = jnp.sum(is_leaf)
+    ranks = jnp.cumsum(is_leaf.astype(jnp.int32)) - 1
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_l, 1))
+    p = jnp.argmax(is_leaf & (ranks == pick))
+    # material: binary(leaf, leaf) or unary(leaf)
+    use_bin = jax.random.uniform(k2, ()) < (
+        cfg.n_binary / max(cfg.n_binary + cfg.n_unary, 1)
+    )
+    if cfg.n_unary == 0:
+        use_bin = jnp.asarray(True)
+    if cfg.n_binary == 0:
+        use_bin = jnp.asarray(False)
+    l1 = _leaf_material(k3, cfg, N)
+    l2 = _leaf_material(k4, cfg, N)
+    ko1, ko2 = jax.random.split(k5)
+    opb = jax.random.randint(ko1, (), 0, max(cfg.n_binary, 1))
+    opu = jax.random.randint(ko2, (), 0, max(cfg.n_unary, 1))
+    # build material arrays: [leaf1, leaf2, op] (binary) or [leaf1, op] (unary)
+    m_len = jnp.where(use_bin, 3, 2)
+    root = m_len - 1
+    kind = jnp.zeros((N,), jnp.int32)
+    kind = kind.at[0].set(l1.kind[0])
+    kind = kind.at[1].set(jnp.where(use_bin, l2.kind[0], KIND_UNARY))
+    kind = kind.at[2].set(jnp.where(use_bin, KIND_BINARY, KIND_PAD))
+    op = jnp.zeros((N,), jnp.int32)
+    op = op.at[1].set(jnp.where(use_bin, 0, opu))
+    op = op.at[2].set(jnp.where(use_bin, opb, 0))
+    lhs = jnp.zeros((N,), jnp.int32).at[root].set(jnp.where(use_bin, 0, 0))
+    rhs = jnp.zeros((N,), jnp.int32).at[2].set(jnp.where(use_bin, 1, 0))
+    feat = jnp.zeros((N,), jnp.int32)
+    feat = feat.at[0].set(l1.feat[0])
+    feat = feat.at[1].set(jnp.where(use_bin, l2.feat[0], 0))
+    val = jnp.zeros((N,), jnp.float32)
+    val = val.at[0].set(l1.val[0])
+    val = val.at[1].set(jnp.where(use_bin, l2.val[0], 0.0))
+    mat = Tree(kind, op, lhs, rhs, feat, val, m_len.astype(jnp.int32))
+    out = replace_range(tree, p, p + 1, mat)
+    return jax.tree_util.tree_map(lambda a, b: jnp.where(n_l > 0, a, b), out, tree)
+
+
+def _insert_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
+    """insert_random_op: wrap a random subtree in a new operator node
+    (/root/reference/src/MutationFunctions.jl:124-143)."""
+    N = tree.n_slots
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    sizes = subtree_sizes(tree)
+    p = _rand_node(k1, tree.length)
+    a = p - sizes[p] + 1
+    blk = extract_block(tree, a, p + 1)
+    blen = blk.length
+    use_bin = jax.random.uniform(k2, ()) < (
+        cfg.n_binary / max(cfg.n_binary + cfg.n_unary, 1)
+    )
+    if cfg.n_unary == 0:
+        use_bin = jnp.asarray(True)
+    if cfg.n_binary == 0:
+        use_bin = jnp.asarray(False)
+    leaf = _leaf_material(k3, cfg, N)
+    ko1, ko2 = jax.random.split(k4)
+    opb = jax.random.randint(ko1, (), 0, max(cfg.n_binary, 1))
+    opu = jax.random.randint(ko2, (), 0, max(cfg.n_unary, 1))
+    # material: [block..., leaf?, op]; binary child order (block, leaf)
+    j = lax.iota(jnp.int32, N)
+    leaf_pos = blen
+    op_pos = jnp.where(use_bin, blen + 1, blen)
+    m_len = op_pos + 1
+    kind = blk.kind
+    kind = jnp.where((j == leaf_pos) & use_bin, leaf.kind[0], kind)
+    kind = jnp.where(j == op_pos, jnp.where(use_bin, KIND_BINARY, KIND_UNARY), kind)
+    op = jnp.where(j == op_pos, jnp.where(use_bin, opb, opu), blk.op)
+    lhs = jnp.where(j == op_pos, blen - 1, blk.lhs)
+    rhs = jnp.where(j == op_pos, jnp.where(use_bin, leaf_pos, 0), blk.rhs)
+    feat = jnp.where((j == leaf_pos) & use_bin, leaf.feat[0], blk.feat)
+    val = jnp.where((j == leaf_pos) & use_bin, leaf.val[0], blk.val)
+    mat = Tree(kind, op, lhs, rhs, feat, val, m_len.astype(jnp.int32))
+    return replace_range(tree, a, p + 1, mat)
+
+
+def _delete_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
+    """delete_random_op: splice a random operator node out, promoting one of
+    its children (/root/reference/src/MutationFunctions.jl:191-234)."""
+    k1, k2 = jax.random.split(key)
+    is_op = tree.kind >= KIND_UNARY
+    n_op = jnp.sum(is_op)
+    ranks = jnp.cumsum(is_op.astype(jnp.int32)) - 1
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1))
+    p = jnp.argmax(is_op & (ranks == pick))
+    sizes = subtree_sizes(tree)
+    keep_right = (tree.kind[p] == KIND_BINARY) & (jax.random.uniform(k2, ()) < 0.5)
+    child = jnp.where(keep_right, tree.rhs[p], tree.lhs[p])
+    ca = child - sizes[child] + 1
+    blk = extract_block(tree, ca, child + 1)
+    out = replace_range(tree, p - sizes[p] + 1, p + 1, blk)
+    return jax.tree_util.tree_map(lambda a, b: jnp.where(n_op > 0, a, b), out, tree)
+
+
+def _randomize(key, tree: Tree, cfg: EvoConfig, curmaxsize) -> Tree:
+    """Fresh random tree (/root/reference/src/Mutate.jl randomize branch);
+    size ~ U[1, curmaxsize] capped by slots."""
+    k1, k2 = jax.random.split(key)
+    m = jax.random.randint(k1, (), 1, jnp.maximum(curmaxsize, 1) + 1)
+    return random_tree(k2, m, tree.n_slots, cfg.nfeatures, cfg.n_unary, cfg.n_binary)
+
+
+def _crossover(key, t1: Tree, t2: Tree, cfg: EvoConfig):
+    """Swap random subtrees between two trees; returns (child1, child2)
+    (/root/reference/src/MutationFunctions.jl:271-303)."""
+    k1, k2 = jax.random.split(key)
+    s1 = subtree_sizes(t1)
+    s2 = subtree_sizes(t2)
+    p1 = _rand_node(k1, t1.length)
+    p2 = _rand_node(k2, t2.length)
+    a1 = p1 - s1[p1] + 1
+    a2 = p2 - s2[p2] + 1
+    b1 = extract_block(t1, a1, p1 + 1)
+    b2 = extract_block(t2, a2, p2 + 1)
+    c1 = replace_range(t1, a1, p1 + 1, b2)
+    c2 = replace_range(t2, a2, p2 + 1, b1)
+    return c1, c2
+
+
+def _condition_weights(tree: Tree, cfg: EvoConfig, curmaxsize) -> jax.Array:
+    """Zero out illegal mutations for this tree's context
+    (/root/reference/src/Mutate.jl:34-76). Returns [8] weights."""
+    w = jnp.asarray(cfg.mutation_weights, jnp.float32)
+    n = tree.length
+    n_const = jnp.sum(tree.kind == KIND_CONST)
+    n_ops = jnp.sum(tree.kind >= KIND_UNARY)
+    at_max = n >= curmaxsize
+    # leaf-only tree: no operator mutation / swap / delete
+    no_ops = n_ops == 0
+    w = w.at[M_OPERATOR].set(jnp.where(no_ops, 0.0, w[M_OPERATOR]))
+    w = w.at[M_SWAP].set(
+        jnp.where(jnp.sum(tree.kind == KIND_BINARY) == 0, 0.0, w[M_SWAP])
+    )
+    w = w.at[M_DELETE].set(jnp.where(no_ops, 0.0, w[M_DELETE]))
+    # no constants: no constant mutation; else scale by min(8, n_const)/8
+    w = w.at[M_CONST].set(
+        jnp.where(
+            n_const == 0,
+            0.0,
+            w[M_CONST] * jnp.minimum(8.0, n_const.astype(jnp.float32)) / 8.0,
+        )
+    )
+    # at maxsize: no growth
+    w = w.at[M_ADD].set(jnp.where(at_max, 0.0, w[M_ADD]))
+    w = w.at[M_INSERT].set(jnp.where(at_max, 0.0, w[M_INSERT]))
+    return w
+
+
+def _apply_mutation(
+    key, tree: Tree, kind_idx, cfg: EvoConfig, curmaxsize, temperature
+) -> Tree:
+    """Dispatch one mutation kind (vmapped callers: all branches trace)."""
+    branches = [
+        lambda k, t: _mutate_constant(k, t, cfg, temperature),
+        lambda k, t: _mutate_operator(k, t, cfg),
+        lambda k, t: _swap_operands(k, t, cfg),
+        lambda k, t: _add_node(k, t, cfg),
+        lambda k, t: _insert_node(k, t, cfg),
+        lambda k, t: _delete_node(k, t, cfg),
+        lambda k, t: _randomize(k, t, cfg, curmaxsize),
+        lambda k, t: t,  # do_nothing
+    ]
+    return lax.switch(kind_idx, branches, key, tree)
+
+
+# ---------------------------------------------------------------------------
+# One evolution event for every island in parallel
+# ---------------------------------------------------------------------------
+
+
+def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
+    """One regularized-evolution event per island: tournament -> mutate or
+    crossover -> score -> Metropolis accept -> ALWAYS replace oldest (the
+    reference replaces the oldest member with the baby even on rejection —
+    the baby is then a copy of the parent;
+    /root/reference/src/RegularizedEvolution.jl:33-105)."""
+    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    key, k_t1, k_t2, k_mut, k_kind, k_flip, k_xo, k_acc = jax.random.split(
+        state.key, 8
+    )
+
+    win1 = jax.vmap(lambda k, s, l: _tournament(k, s, l, state.freq, cfg))(
+        jax.random.split(k_t1, I), state.score, state.length
+    )
+    win2 = jax.vmap(lambda k, s, l: _tournament(k, s, l, state.freq, cfg))(
+        jax.random.split(k_t2, I), state.score, state.length
+    )
+
+    isl = jnp.arange(I)
+    parent1 = jax.vmap(lambda i, p: _member_tree(state, i, p))(isl, win1)
+    parent2 = jax.vmap(lambda i, p: _member_tree(state, i, p))(isl, win2)
+    pscore1 = state.score[isl, win1]
+    ploss1 = state.loss[isl, win1]
+    pscore2 = state.score[isl, win2]
+    ploss2 = state.loss[isl, win2]
+
+    do_xover = (
+        jax.random.uniform(k_flip, (I,)) < cfg.crossover_probability
+        if cfg.crossover_probability > 0
+        else jnp.zeros((I,), bool)
+    )
+
+    # mutation path
+    def choose_kind(k, tree):
+        w = _condition_weights(tree, cfg, curmaxsize)
+        # all-zero guard: degenerate contexts fall back to do_nothing
+        w = w.at[M_NOTHING].add(jnp.where(jnp.sum(w) <= 0, 1.0, 0.0))
+        return jax.random.choice(k, 8, p=w / jnp.sum(w))
+
+    mut_kinds = jax.vmap(choose_kind)(jax.random.split(k_kind, I), parent1)
+    mutated = jax.vmap(
+        lambda k, t, m: _apply_mutation(k, t, m, cfg, curmaxsize, temperature)
+    )(jax.random.split(k_mut, I), parent1, mut_kinds)
+
+    # crossover path (children pair)
+    xo1, xo2 = jax.vmap(lambda k, a, b: _crossover(k, a, b, cfg))(
+        jax.random.split(k_xo, I), parent1, parent2
+    )
+
+    def pick(a, b, flag):
+        return jax.tree_util.tree_map(
+            lambda x, y: jnp.where(flag.reshape((I,) + (1,) * (x.ndim - 1)), x, y),
+            a,
+            b,
+        )
+
+    cand1 = pick(xo1, mutated, do_xover)
+    # cand2 is only meaningful where do_xover; stub the rest down to a 1-node
+    # leaf so the kernel's length-bounded slot loop does ~no work for them
+    # (they are still scored — static [2I] batch — but at leaf cost)
+    leaf_stub = Tree(
+        kind=jnp.zeros((I, N), jnp.int32).at[:, 0].set(KIND_CONST),
+        op=jnp.zeros((I, N), jnp.int32),
+        lhs=jnp.zeros((I, N), jnp.int32),
+        rhs=jnp.zeros((I, N), jnp.int32),
+        feat=jnp.zeros((I, N), jnp.int32),
+        val=jnp.zeros((I, N), jnp.float32),
+        length=jnp.ones((I,), jnp.int32),
+    )
+    cand2 = pick(xo2, leaf_stub, do_xover)
+
+    # validity: complexity (= node count) and depth caps; one attempt, invalid
+    # falls back to the parent (skip_mutation_failures semantics)
+    def validate(c, parent):
+        depth = jax.vmap(tree_depth)(c)
+        ok = (c.length <= jnp.minimum(curmaxsize, N)) & (depth <= cfg.maxdepth)
+        out = pick(c, parent, ok)
+        return out, ok
+
+    cand1, ok1 = validate(cand1, parent1)
+    cand2, ok2 = validate(cand2, parent2)
+
+    # --- score both candidate sets in ONE batched call: [2I] trees ----------
+    batch = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), cand1, cand2
+    )
+    losses = score_fn(batch)  # [2I]
+    loss1, loss2 = losses[:I], losses[I:]
+    score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg)
+    score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg)
+
+    # --- Metropolis accept (mutation path only; crossover children are
+    # accepted whenever valid+finite, /root/reference/src/Mutate.jl:361-429) --
+    fnorm = state.freq / jnp.maximum(jnp.sum(state.freq), 1e-30)
+    sz_old = jnp.clip(state.length[isl, win1], 0, cfg.maxsize)
+    sz_new = jnp.clip(cand1.length, 0, cfg.maxsize)
+    prob = jnp.ones((I,), jnp.float32)
+    if cfg.annealing:
+        delta = score1 - pscore1
+        # temperature hits exactly 0 on the final cycle: IEEE inf/0 semantics
+        # match the reference (NaN/0-division -> accept), so no epsilon guard
+        prob = prob * jnp.exp(-delta / (cfg.alpha * temperature))
+    if cfg.use_frequency:
+        old_f = jnp.maximum(fnorm[sz_old], 1e-6)
+        new_f = jnp.maximum(fnorm[sz_new], 1e-6)
+        prob = prob * (old_f / new_f)
+    u = jax.random.uniform(k_acc, (I,))
+    accept1 = ~(prob < u) & jnp.isfinite(loss1) & ok1
+    accept1 = jnp.where(do_xover, jnp.isfinite(loss1) & ok1, accept1)
+    accept2 = do_xover & jnp.isfinite(loss2) & ok2
+
+    # final babies: candidate on accept, parent copy on reject
+    baby1 = pick(cand1, parent1, accept1)
+    baby2 = pick(cand2, parent2, accept2)
+    bloss1 = jnp.where(accept1, loss1, ploss1)
+    bscore1 = jnp.where(accept1, score1, pscore1)
+    bloss2 = jnp.where(accept2, loss2, ploss2)
+    bscore2 = jnp.where(accept2, score2, pscore2)
+
+    # --- replace oldest (always), crossover replaces the two oldest ---------
+    def insert(st: EvoState, member_idx, tree_b, loss_b, score_b, mask):
+        """Overwrite member_idx of each island with tree_b where mask (mask
+        only gates crossover's second slot; first slot always inserts)."""
+        sel = lambda cur, new: cur.at[isl, member_idx].set(
+            jnp.where(mask.reshape((I,) + (1,) * (new.ndim - 1)), new, cur[isl, member_idx])
+        )
+        return st._replace(
+            kind=sel(st.kind, tree_b.kind),
+            op=sel(st.op, tree_b.op),
+            lhs=sel(st.lhs, tree_b.lhs),
+            rhs=sel(st.rhs, tree_b.rhs),
+            feat=sel(st.feat, tree_b.feat),
+            val=sel(st.val, tree_b.val),
+            length=st.length.at[isl, member_idx].set(
+                jnp.where(mask, tree_b.length, st.length[isl, member_idx])
+            ),
+            loss=st.loss.at[isl, member_idx].set(
+                jnp.where(mask, loss_b, st.loss[isl, member_idx])
+            ),
+            score=st.score.at[isl, member_idx].set(
+                jnp.where(mask, score_b, st.score[isl, member_idx])
+            ),
+            birth=st.birth.at[isl, member_idx].set(
+                jnp.where(mask, st.step, st.birth[isl, member_idx])
+            ),
+        )
+
+    oldest1 = jnp.argmin(state.birth, axis=1)
+    st = insert(state, oldest1, baby1, bloss1, bscore1, jnp.ones((I,), bool))
+    oldest2 = jnp.argmin(
+        st.birth.at[isl, oldest1].set(jnp.iinfo(jnp.int32).max), axis=1
+    )
+    st = insert(st, oldest2, baby2, bloss2, bscore2, do_xover)
+
+    # --- frequency histogram (accepted inserts) ------------------------------
+    freq = st.freq.at[jnp.clip(baby1.length, 0, cfg.maxsize)].add(
+        jnp.where(accept1, 1.0, 0.0)
+    )
+    freq = freq.at[jnp.clip(baby2.length, 0, cfg.maxsize)].add(
+        jnp.where(accept2, 1.0, 0.0)
+    )
+
+    # --- best-seen per complexity (the per-cycle mini hall of fame,
+    # /root/reference/src/SingleIteration.jl:64-100). Deterministic per-size
+    # argmin via a one-hot [S+1, 2I] mask (duplicate-index scatter order is
+    # implementation-defined in XLA, so last-write-wins tricks are unsafe) ----
+    all_loss = jnp.concatenate([loss1, loss2])
+    all_valid = jnp.concatenate(
+        [jnp.isfinite(loss1) & ok1, jnp.isfinite(loss2) & ok2 & do_xover]
+    )
+    sizes_all = jnp.clip(batch.length, 0, cfg.maxsize)
+    S1 = cfg.maxsize + 1
+    size_mask = sizes_all[None, :] == jnp.arange(S1)[:, None]  # [S1, 2I]
+    cand_loss = jnp.where(size_mask & all_valid[None, :], all_loss[None, :], jnp.inf)
+    best_idx = jnp.argmin(cand_loss, axis=1)  # [S1]
+    best_loss_s = jnp.min(cand_loss, axis=1)
+    better = best_loss_s < st.bs_loss
+    bs_loss = jnp.where(better, best_loss_s, st.bs_loss)
+    tree_fields = [batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat, batch.val]
+    bt_new = [
+        jnp.where(better[:, None], field[best_idx], cur)
+        for cur, field in zip(st.bs_tree[:6], tree_fields)
+    ]
+    bs_len = jnp.where(better, batch.length[best_idx], st.bs_tree[6])
+    bs_exists = st.bs_exists | better
+
+    n_scored = I + jnp.sum(do_xover)
+    return st._replace(
+        freq=freq,
+        bs_loss=bs_loss,
+        bs_tree=(*bt_new, bs_len),
+        bs_exists=bs_exists,
+        key=key,
+        step=st.step + 1,
+        num_evals=st.num_evals + n_scored.astype(jnp.float32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Iteration program: ncycles x events, then migration — ONE compiled program
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))
+def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
+    """Advance every island through one full iteration (the reference's
+    _dispatch_s_r_cycle, /root/reference/src/SymbolicRegression.jl:1088-1129):
+    ncycles of regularized evolution with annealed temperature, then
+    migration. Constant optimization runs as a separate device program
+    (ops/constant_opt.py) driven by models/device_search.py.
+
+    NOTE every argument is a device array or static — post-first-readback this
+    backend charges ~100ms fixed per host-to-device transfer, so even scalars
+    (curmaxsize) are computed ON DEVICE from state.iteration."""
+    E = cfg.events_per_cycle
+    total = cfg.ncycles * E
+
+    # warmup-maxsize schedule (get_cur_maxsize,
+    # /root/reference/src/SearchUtils.jl:458-470), on device
+    if cfg.warmup_maxsize_by > 0:
+        frac_done = state.iteration.astype(jnp.float32) / max(cfg.niterations, 1)
+        in_warmup = frac_done / cfg.warmup_maxsize_by
+        curmaxsize = jnp.minimum(
+            3 + (in_warmup * (cfg.maxsize - 3)).astype(jnp.int32), cfg.maxsize
+        )
+    else:
+        curmaxsize = jnp.asarray(cfg.maxsize, jnp.int32)
+
+    def body(i, st):
+        cycle = i // E
+        # linspace(1, 0, ncycles): the final cycle runs at exactly T=0
+        # (host parity: models/single_iteration.py np.linspace(1.0, 0.0, n))
+        frac = cycle.astype(jnp.float32) / max(cfg.ncycles - 1, 1)
+        temperature = 1.0 - frac if cfg.annealing else jnp.asarray(1.0)
+        return _event(st, cfg, score_fn, temperature, curmaxsize)
+
+    state = lax.fori_loop(0, total, body, state)
+    state = state._replace(iteration=state.iteration + 1)
+
+    # frequency-window decay (proportional-smoothing variant of move_window!,
+    # /root/reference/src/AdaptiveParsimony.jl:57-89; window 100k)
+    total_f = jnp.sum(state.freq)
+    window = 100_000.0
+    state = state._replace(
+        freq=jnp.where(total_f > window, state.freq * (window / total_f), state.freq)
+    )
+
+    # --- migration (reference: /root/reference/src/Migration.jl:16-38) ------
+    if cfg.migration:
+        state = _migrate(state, cfg, use_hof=False)
+    if cfg.hof_migration:
+        state = _migrate(state, cfg, use_hof=True)
+    return state
+
+
+def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
+    """Replace random members with samples from the migration pool: topn per
+    island (best_sub_pop) or the best-seen frontier (hof)."""
+    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    S = cfg.maxsize
+    key, k_sel, k_pick = jax.random.split(state.key, 3)
+    frac = cfg.fraction_replaced_hof if use_hof else cfg.fraction_replaced
+
+    if use_hof:
+        pool_loss = jnp.where(state.bs_exists, state.bs_loss, jnp.inf)
+        pool_fields = state.bs_tree  # [S+1, ...]
+        pool_n = S + 1
+        pool_valid = state.bs_exists
+        pk, po, pl, pr, pf, pv, pln = pool_fields
+        pool_kind, pool_op, pool_lhs, pool_rhs, pool_feat, pool_val, pool_len = (
+            pk, po, pl, pr, pf, pv, pln
+        )
+    else:
+        k = cfg.topn
+        top_idx = jnp.argsort(state.score, axis=1)[:, :k]  # [I, k]
+        isl = jnp.arange(I)[:, None]
+        pool_kind = state.kind[isl, top_idx].reshape(I * k, N)
+        pool_op = state.op[isl, top_idx].reshape(I * k, N)
+        pool_lhs = state.lhs[isl, top_idx].reshape(I * k, N)
+        pool_rhs = state.rhs[isl, top_idx].reshape(I * k, N)
+        pool_feat = state.feat[isl, top_idx].reshape(I * k, N)
+        pool_val = state.val[isl, top_idx].reshape(I * k, N)
+        pool_len = state.length[isl, top_idx].reshape(I * k)
+        pool_loss = state.loss[isl, top_idx].reshape(I * k)
+        pool_n = I * k
+        pool_valid = jnp.isfinite(pool_loss)
+
+    # Bernoulli(frac) per member (reference draws a Poisson count: same mean)
+    replace = jax.random.uniform(k_sel, (I, P)) < frac
+    # never replace into islands from an empty pool
+    any_valid = jnp.any(pool_valid)
+    replace = replace & any_valid
+    probs = jnp.where(pool_valid, 1.0, 0.0)
+    probs = probs / jnp.maximum(jnp.sum(probs), 1e-30)
+    src = jax.random.choice(k_pick, pool_n, shape=(I, P), p=probs)
+
+    def mix(cur, pool):
+        take = pool[src]  # [I, P, ...]
+        m = replace.reshape((I, P) + (1,) * (cur.ndim - 2))
+        return jnp.where(m, take, cur)
+
+    loss = jnp.where(replace, pool_loss[src], state.loss)
+    comp = jnp.where(replace, pool_len[src], state.length).astype(jnp.float32)
+    score = jnp.where(replace, _score_of(pool_loss[src], comp, cfg), state.score)
+    return state._replace(
+        kind=mix(state.kind, pool_kind),
+        op=mix(state.op, pool_op),
+        lhs=mix(state.lhs, pool_lhs),
+        rhs=mix(state.rhs, pool_rhs),
+        feat=mix(state.feat, pool_feat),
+        val=mix(state.val, pool_val),
+        length=jnp.where(replace, pool_len[src], state.length),
+        loss=loss,
+        score=score,
+        birth=jnp.where(replace, state.step, state.birth),
+        key=key,
+    )

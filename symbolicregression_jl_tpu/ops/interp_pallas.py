@@ -405,6 +405,27 @@ def _loss_pallas(ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_ti
     )
 
 
+def pack_batch_jnp(kind, op, lhs, rhs, feat, length, opset: OperatorSet):
+    """In-graph (jnp) packing of batched tree arrays [B, N] into the fused
+    kernel layout: (ints [B, L], vals-shaped pad widths). THE canonical
+    traced implementation of the 'code | lhs | rhs | feat | length' contract —
+    pack_flat_fused (numpy) and FlatSlab.set_tree must agree with it (pinned
+    by tests/test_pallas.py)."""
+    B, N = kind.shape
+    L = _round_up(4 * N + 1, 128)
+    code = jnp.where(
+        kind == KIND_VAR,
+        1,
+        jnp.where(
+            kind == KIND_UNARY,
+            2 + op,
+            jnp.where(kind == KIND_BINARY, 2 + opset.n_unary + op, 0),
+        ),
+    ).astype(jnp.int32)
+    ints = jnp.concatenate([code, lhs, rhs, feat, length[:, None]], axis=1)
+    return jnp.pad(ints, ((0, 0), (0, L - ints.shape[1])))
+
+
 def pack_flat_fused(flat: FlatTrees, opset: OperatorSet):
     """Pack FlatTrees into the fused-opcode layout.
     ints [P, L]: code | lhs | rhs | feat | length (L = roundup(4N+1, 128));

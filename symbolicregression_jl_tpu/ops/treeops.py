@@ -1,0 +1,293 @@
+"""Vectorized expression-tree surgery on flat postorder tensors — in-jit.
+
+The device-resident evolution engine (ops/evolve.py) needs the reference's
+tree-rewrite primitives (/root/reference/src/MutationFunctions.jl) expressed as
+pure JAX index arithmetic so they run INSIDE a compiled program, vmapped over
+whole populations. The enabling invariant is postorder contiguity: the subtree
+rooted at slot ``p`` occupies exactly the contiguous slot range
+``[p - size(p) + 1, p]``, and every child pointer targets a smaller slot.
+Every structural mutation is therefore a piecewise-affine re-indexing
+(``replace_range``) plus a pointer remap — one gather per field, no host.
+
+Single-tree functions here take arrays of shape [N] (+ scalar length) and are
+``jax.vmap``-ed by the engine. Layout matches ops/flat.py's FlatTrees row:
+kind (KIND_*), op, lhs, rhs, feat (int32[N]), val (float32[N]), length.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .flat import KIND_BINARY, KIND_CONST, KIND_PAD, KIND_UNARY, KIND_VAR
+
+__all__ = [
+    "Tree",
+    "subtree_sizes",
+    "subtree_start",
+    "extract_block",
+    "replace_range",
+    "random_tree",
+    "tree_depth",
+]
+
+
+class Tree(NamedTuple):
+    """One flat postorder tree (unbatched; engine vmaps over a leading dim)."""
+
+    kind: jax.Array  # int32[N]
+    op: jax.Array  # int32[N]
+    lhs: jax.Array  # int32[N]
+    rhs: jax.Array  # int32[N]
+    feat: jax.Array  # int32[N]
+    val: jax.Array  # float32[N]
+    length: jax.Array  # int32 scalar
+
+    @property
+    def n_slots(self) -> int:
+        return self.kind.shape[0]
+
+
+def _iota(n):
+    return lax.iota(jnp.int32, n)
+
+
+def subtree_sizes(tree: Tree) -> jax.Array:
+    """size[i] = node count of the subtree rooted at slot i (postorder:
+    children precede parents, so one forward pass suffices). Pad slots get 0."""
+    N = tree.n_slots
+    is_un = tree.kind == KIND_UNARY
+    is_bin = tree.kind == KIND_BINARY
+    live = tree.kind != KIND_PAD
+
+    def body(i, size):
+        l = size[tree.lhs[i]]
+        r = size[tree.rhs[i]]
+        s = jnp.where(
+            is_bin[i], 1 + l + r, jnp.where(is_un[i], 1 + l, 1)
+        ) * live[i].astype(jnp.int32)
+        return size.at[i].set(s)
+
+    return lax.fori_loop(0, N, body, jnp.zeros(N, jnp.int32))
+
+
+def subtree_start(sizes: jax.Array, p) -> jax.Array:
+    """First slot of the subtree rooted at p (inclusive)."""
+    return p - sizes[p] + 1
+
+
+def tree_depth(tree: Tree) -> jax.Array:
+    """Max node depth (root = 1), one forward pass like subtree_sizes."""
+    N = tree.n_slots
+    is_un = tree.kind == KIND_UNARY
+    is_bin = tree.kind == KIND_BINARY
+
+    def body(i, d):
+        l = d[tree.lhs[i]]
+        r = d[tree.rhs[i]]
+        di = jnp.where(is_bin[i], 1 + jnp.maximum(l, r), jnp.where(is_un[i], 1 + l, 1))
+        return d.at[i].set(di)
+
+    depths = lax.fori_loop(0, N, body, jnp.zeros(N, jnp.int32))
+    return depths[tree.length - 1]
+
+
+def extract_block(tree: Tree, a, b) -> Tree:
+    """Materialize subtree block [a, b) at offset 0: arrays shifted left by a,
+    internal child pointers rebased, root at slot b-a-1, pads beyond."""
+    N = tree.n_slots
+    j = _iota(N)
+    src = jnp.clip(j + a, 0, N - 1)
+    m = b - a
+    inside = j < m
+
+    def take(arr, fill=0):
+        return jnp.where(inside, arr[src], fill)
+
+    kind = take(tree.kind, KIND_PAD)
+    return Tree(
+        kind=kind,
+        op=take(tree.op),
+        lhs=jnp.where(
+            inside & (kind >= KIND_UNARY), jnp.maximum(tree.lhs[src] - a, 0), 0
+        ),
+        rhs=jnp.where(
+            inside & (kind == KIND_BINARY), jnp.maximum(tree.rhs[src] - a, 0), 0
+        ),
+        feat=take(tree.feat),
+        val=jnp.where(inside, tree.val[src], 0.0),
+        length=m.astype(jnp.int32),
+    )
+
+
+def replace_range(tree: Tree, a, b, mat: Tree) -> Tree:
+    """Replace slot range [a, b) — which MUST be a whole subtree block — with
+    material ``mat`` (a self-contained block at offset 0, root at
+    mat.length-1). Returns the re-knit tree; new length = L - (b-a) + m.
+
+    Pointer algebra (postorder contiguity): slots < a are untouched; copied
+    slots >= a+m had pointers c where c < a stays, c == b-1 (the old subtree
+    root, referenced only by its direct parent) becomes a+m-1 (the new root),
+    and c >= b shifts by m - (b-a). Callers must ensure the new length fits
+    in n_slots (reject oversize candidates BEFORE calling)."""
+    N = tree.n_slots
+    m = mat.length
+    shift = m - (b - a)
+    new_len = tree.length + shift
+    j = _iota(N)
+
+    reg_pre = j < a
+    reg_mat = (j >= a) & (j < a + m)
+    reg_post = (j >= a + m) & (j < new_len)
+
+    src_tree = jnp.clip(jnp.where(reg_pre, j, j - shift), 0, N - 1)
+    src_mat = jnp.clip(j - a, 0, N - 1)
+
+    def pick(tree_arr, mat_arr, fill):
+        return jnp.where(
+            reg_mat,
+            mat_arr[src_mat],
+            jnp.where(reg_pre | reg_post, tree_arr[src_tree], fill),
+        )
+
+    kind = pick(tree.kind, mat.kind, KIND_PAD)
+    op = pick(tree.op, mat.op, 0)
+    feat = pick(tree.feat, mat.feat, 0)
+    val = pick(tree.val, mat.val, 0.0)
+
+    def remap_ptr(ptr_tree, ptr_mat):
+        c = ptr_tree[src_tree]
+        c_post = jnp.where(c < a, c, jnp.where(c == b - 1, a + m - 1, c + shift))
+        return jnp.where(
+            reg_mat,
+            ptr_mat[src_mat] + a,
+            jnp.where(reg_pre, c, jnp.where(reg_post, c_post, 0)),
+        )
+
+    # canonical form: pointer fields are 0 on non-operator slots (keeps
+    # structural comparisons exact; no consumer reads them there)
+    lhs = jnp.where(
+        kind >= KIND_UNARY, jnp.clip(remap_ptr(tree.lhs, mat.lhs), 0, N - 1), 0
+    )
+    rhs = jnp.where(
+        kind == KIND_BINARY, jnp.clip(remap_ptr(tree.rhs, mat.rhs), 0, N - 1), 0
+    )
+    return Tree(kind, op, lhs, rhs, feat, val, new_len.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Random tree generation (device-side `gen_random_tree_fixed_size`,
+# /root/reference/src/MutationFunctions.jl:237-268) via the cycle lemma:
+# sample an arity multiset with sum = m-1, shuffle, then the unique rotation
+# whose Łukasiewicz path stays positive is a valid postorder program.
+# ---------------------------------------------------------------------------
+
+
+def random_tree(
+    key: jax.Array,
+    m,
+    n_slots: int,
+    nfeatures: int,
+    n_unary: int,
+    n_binary: int,
+) -> Tree:
+    """A uniform-ish random postorder tree with exactly ``m`` nodes
+    (m clamped to [1, n_slots], adjusted down by 1 when no unary operators
+    exist and m is even — node counts must then be odd). Leaves are 50/50
+    constant (standard normal value) / random feature, mirroring
+    make_random_leaf (/root/reference/src/MutationFunctions.jl:167-175)."""
+    N = n_slots
+    k_b, k_shuf, k_ops, k_leaf, k_val = jax.random.split(key, 5)
+    m = jnp.clip(m, 1, N)
+    if n_binary == 0:
+        b = jnp.zeros((), jnp.int32)
+        m = jnp.where(n_unary == 0, 1, m)
+    elif n_unary == 0:
+        m = jnp.where(m % 2 == 0, jnp.maximum(m - 1, 1), m)  # need u = 0
+        b = (m - 1) // 2
+    else:
+        b = jax.random.randint(k_b, (), 0, jnp.maximum((m - 1) // 2 + 1, 1))
+    u = m - 1 - 2 * b
+
+    j = _iota(N)
+    # arity array: b twos, then u ones, then leaves, then pad
+    arity = jnp.where(
+        j < b, 2, jnp.where(j < b + u, 1, jnp.where(j < m, 0, 0))
+    ).astype(jnp.int32)
+    live = j < m
+
+    # shuffle the first m entries (pads sort to the end via +inf keys)
+    keys = jnp.where(live, jax.random.uniform(k_shuf, (N,)), jnp.inf)
+    perm = jnp.argsort(keys)
+    arity = jnp.where(live, arity[perm], 0)
+
+    # cycle lemma: prefix sums of (1 - arity) over live slots; rotate so the
+    # sequence starts just after the (last) minimum -> all prefixes >= 1
+    steps = jnp.where(live, 1 - arity, 0)
+    prefix = jnp.cumsum(steps)
+    masked = jnp.where(live, prefix, jnp.iinfo(jnp.int32).max)
+    # last occurrence of the minimum
+    minval = jnp.min(masked)
+    r = (N - 1) - jnp.argmax((masked == minval)[::-1])
+    rot_src = jnp.where(live, (r + 1 + j) % jnp.maximum(m, 1), 0)
+    arity = jnp.where(live, arity[rot_src], 0)
+
+    # assign kinds/ops/leaves
+    is_bin = arity == 2
+    is_un = arity == 1
+    is_leaf = live & (arity == 0)
+    const_mask = jax.random.uniform(k_leaf, (N,)) < 0.5
+    if nfeatures <= 0:
+        const_mask = jnp.ones((N,), bool)
+    kind = jnp.where(
+        is_bin,
+        KIND_BINARY,
+        jnp.where(
+            is_un,
+            KIND_UNARY,
+            jnp.where(is_leaf & const_mask, KIND_CONST, KIND_VAR),
+        ),
+    ).astype(jnp.int32)
+    kind = jnp.where(live, kind, KIND_PAD)
+    k1, k2, k3 = jax.random.split(k_ops, 3)
+    op = jnp.where(
+        is_bin,
+        jax.random.randint(k1, (N,), 0, max(n_binary, 1)),
+        jax.random.randint(k2, (N,), 0, max(n_unary, 1)),
+    ).astype(jnp.int32)
+    feat = jax.random.randint(k3, (N,), 0, max(nfeatures, 1)).astype(jnp.int32)
+    # independent key for values: reusing k_leaf here would correlate the
+    # const/var coin with the value's sign (all constants would be negative)
+    val = jax.random.normal(k_val, (N,), jnp.float32)
+
+    # child pointers via stack simulation (N small; scalar-ish per step)
+    def body(i, carry):
+        stack, sp, lhs, rhs = carry
+        a_i = arity[i]
+        inb = i < m
+        top1 = stack[jnp.maximum(sp - 1, 0)]
+        top2 = stack[jnp.maximum(sp - 2, 0)]
+        lhs = lhs.at[i].set(
+            jnp.where(inb & (a_i == 2), top2, jnp.where(inb & (a_i == 1), top1, 0))
+        )
+        rhs = rhs.at[i].set(jnp.where(inb & (a_i == 2), top1, 0))
+        sp = jnp.where(inb, sp - a_i, sp)
+        stack = jnp.where(inb, stack.at[jnp.maximum(sp, 0)].set(i), stack)
+        sp = jnp.where(inb, sp + 1, sp)
+        return stack, sp, lhs, rhs
+
+    _, _, lhs, rhs = lax.fori_loop(
+        0,
+        N,
+        body,
+        (
+            jnp.zeros(N, jnp.int32),
+            jnp.zeros((), jnp.int32),
+            jnp.zeros(N, jnp.int32),
+            jnp.zeros(N, jnp.int32),
+        ),
+    )
+    return Tree(kind, op, lhs, rhs, feat, val, m.astype(jnp.int32))

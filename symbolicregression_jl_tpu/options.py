@@ -151,7 +151,11 @@ class Options:
     # -- TPU-specific --------------------------------------------------------
     dtype: Any = np.float32  # device compute dtype for eval/scoring
     pad_multiple: int = 8  # node-slot padding bucket (compile-cache granularity)
-    scheduler: str = "lockstep"  # "lockstep" (vectorized islands) | "async"
+    # "lockstep": host-driven vectorized islands (full feature set);
+    # "device": entire evolution loop on-device, one program per iteration —
+    #   fastest on TPU, subset of features (see device_mode_supported);
+    # "async": reference-style async island scheduler (parallel/islands.py)
+    scheduler: str = "lockstep"
     data_sharding: str | None = None  # "rows" to shard dataset rows over devices
 
     # -- derived (filled in __post_init__) -----------------------------------
@@ -170,6 +174,11 @@ class Options:
             self.should_simplify = self.loss_function is None
         if self.deterministic and self.seed is None:
             self.seed = 0
+        if self.scheduler not in ("lockstep", "device", "async"):
+            raise ValueError(
+                f"unknown scheduler {self.scheduler!r}; "
+                "expected 'lockstep', 'device', or 'async'"
+            )
 
         self._op_constraints = _normalize_constraints(self.constraints, self.operators)
         self._nested_constraints = _normalize_nested(

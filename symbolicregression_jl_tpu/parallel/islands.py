@@ -1,0 +1,201 @@
+"""Async island scheduler (Options.scheduler="async").
+
+Reproduces the reference's fully-async island model
+(/root/reference/src/SymbolicRegression.jl:837-1064): each island runs its own
+work unit — one full iteration (`ncycles_per_iteration` evolve passes +
+simplify + constant optimization, the unit shipped by `@sr_spawner`) — and the
+head loop merges results as they complete: update the hall of fame and search
+statistics, save the CSV, migrate from the freshest snapshots, and immediately
+re-spawn that island's next work unit. Islands therefore evolve
+asynchronously — no barrier between them; migration reads "whatever snapshot
+is current" exactly like the reference (:933-943).
+
+Concurrency model: a thread pool plays the role of Julia's Task scheduler
+(`Threads.@spawn` in :multithreading mode, /root/reference/src/SearchUtils.jl:121-122).
+Host-side evolution interleaves under the GIL while every island's batched
+scoring runs as overlapping async device dispatches — the same overlap the
+reference gets from Task/Future machinery. Per-island RunningSearchStatistics
+are deep copies (reference deep-copies per work unit,
+/root/reference/src/SymbolicRegression.jl:811,964); the head merges them by
+re-accumulating completed members into the shared histogram.
+
+Like the reference's async mode, results depend on completion order — use
+scheduler="lockstep" with deterministic=True for reproducibility.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+import numpy as np
+
+from ..complexity import compute_complexity
+from ..models.adaptive_parsimony import RunningSearchStatistics
+from ..models.hall_of_fame import HallOfFame
+from ..models.migration import migrate
+from ..models.population import Population
+from ..models.scorer import BatchScorer
+from ..models.single_iteration import (
+    optimize_and_simplify_populations,
+    s_r_cycle_lockstep,
+)
+
+__all__ = ["async_search_one_output"]
+
+
+def async_search_one_output(
+    dataset,
+    options,
+    niterations: int,
+    rng: np.random.Generator,
+    saved_state=None,
+    verbosity: int = 1,
+    output_file: str | None = None,
+):
+    """Async-island counterpart of search._search_one_output (same contract)."""
+    from ..search import SearchResult, _init_population, _rescore_population, get_cur_maxsize
+    from ..utils.export_csv import save_hall_of_fame
+
+    scorer = BatchScorer(dataset, options)
+    nfeatures = dataset.n_features
+    n_islands = options.populations
+
+    hof = HallOfFame(options.maxsize)
+    if saved_state is not None:
+        pops = []
+        for pop in saved_state.populations[:n_islands]:
+            pop = pop.copy()
+            if pop.n != options.population_size:
+                pops.append(_init_population(scorer, options, nfeatures, rng))
+            else:
+                pops.append(_rescore_population(pop, scorer, options))
+        while len(pops) < n_islands:
+            pops.append(_init_population(scorer, options, nfeatures, rng))
+        for m in saved_state.hall_of_fame.members:
+            if m is not None:
+                hof.update(m, options)
+    else:
+        pops = [
+            _init_population(scorer, options, nfeatures, rng)
+            for _ in range(n_islands)
+        ]
+
+    shared_stats = RunningSearchStatistics(options.maxsize)
+    # independent RNG stream per island (thread-safe, reproducible spawn)
+    seeds = np.random.SeedSequence(
+        options.seed if options.seed is not None else rng.integers(2**31)
+    ).spawn(n_islands)
+    island_rngs = [np.random.default_rng(s) for s in seeds]
+
+    lock = threading.Lock()  # guards hof / stats / pops / scorer counters
+    early_stop = options.early_stop_fn()
+    start_time = time.time()
+    stop_reason: list = [None]
+    cycles_left = [niterations] * n_islands
+
+    def work_unit(i: int, iteration: int):
+        """One island's iteration: the reference's _dispatch_s_r_cycle
+        (/root/reference/src/SymbolicRegression.jl:1088-1129)."""
+        with lock:
+            pop = pops[i].copy()
+            stats = shared_stats.copy()  # deep copy per work unit
+            curmaxsize = get_cur_maxsize(iteration, niterations, options)
+        irng = island_rngs[i]
+        best_seen = s_r_cycle_lockstep(
+            [pop],
+            scorer,
+            options.ncycles_per_iteration,
+            curmaxsize,
+            [stats],
+            options,
+            nfeatures,
+            irng,
+        )[0]
+        optimize_and_simplify_populations([pop], scorer, options, irng)
+        return i, pop, best_seen
+
+    def on_complete(i: int, pop: Population, best_seen: HallOfFame):
+        """Head-side merge (reference main loop :896-1006)."""
+        with lock:
+            pops[i] = pop
+            hof.merge(best_seen, options)
+            hof.update_many(pop.members, options)
+            for m in pop.members:
+                shared_stats.update(m.get_complexity(options))
+            shared_stats.move_window()
+            shared_stats.normalize()
+            # migration into THIS island from current snapshots
+            if options.migration:
+                all_best = [
+                    m
+                    for p in pops
+                    for m in p.best_sub_pop(options.topn).members
+                ]
+                migrate(all_best, pops[i], options, options.fraction_replaced, rng)
+            if options.hof_migration:
+                frontier = hof.pareto_frontier()
+                if frontier:
+                    migrate(
+                        frontier, pops[i], options, options.fraction_replaced_hof, rng
+                    )
+            if output_file and options.save_to_file:
+                save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+            if verbosity > 0:
+                elapsed = time.time() - start_time
+                done = niterations * n_islands - sum(cycles_left)
+                print(
+                    f"[async {done}/{niterations * n_islands} units] "
+                    f"evals={scorer.num_evals:.3g} elapsed={elapsed:.1f}s"
+                )
+            # stop conditions (reference :1053-1060)
+            if early_stop is not None and any(
+                early_stop(m.loss, m.get_complexity(options))
+                for m in hof.pareto_frontier()
+            ):
+                stop_reason[0] = "early_stop"
+            if (
+                options.timeout_in_seconds is not None
+                and time.time() - start_time > options.timeout_in_seconds
+            ):
+                stop_reason[0] = "timeout"
+            if options.max_evals is not None and scorer.num_evals >= options.max_evals:
+                stop_reason[0] = "max_evals"
+
+    max_workers = min(n_islands, 8)
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        pending = {}
+        for i in range(n_islands):
+            fut = pool.submit(work_unit, i, niterations - cycles_left[i])
+            pending[fut] = i
+        while pending:
+            done, _ = wait(pending, return_when=FIRST_COMPLETED)
+            for fut in done:
+                i = pending.pop(fut)
+                idx, pop, best_seen = fut.result()
+                cycles_left[idx] -= 1
+                on_complete(idx, pop, best_seen)
+                if stop_reason[0] is None and cycles_left[idx] > 0:
+                    nfut = pool.submit(
+                        work_unit, idx, niterations - cycles_left[idx]
+                    )
+                    pending[nfut] = idx
+            if stop_reason[0] is not None:
+                # drain without re-spawning
+                for fut in list(pending):
+                    i = pending.pop(fut)
+                    idx, pop, best_seen = fut.result()
+                    cycles_left[idx] -= 1
+                    on_complete(idx, pop, best_seen)
+                break
+
+    result = SearchResult(
+        hall_of_fame=hof,
+        populations=pops,
+        dataset=dataset,
+        options=options,
+        num_evals=scorer.num_evals,
+    )
+    result.stop_reason = stop_reason[0]
+    return result

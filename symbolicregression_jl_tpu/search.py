@@ -297,6 +297,36 @@ def equation_search(
         if options.save_to_file:
             base = options.output_file or f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"
             output_file = base if nout == 1 else f"{base}.out{j + 1}"
+        if options.scheduler == "async":
+            from .parallel.islands import async_search_one_output
+
+            results.append(
+                async_search_one_output(
+                    dataset,
+                    options,
+                    niterations,
+                    rng,
+                    saved_state=saved[j] if saved is not None else None,
+                    verbosity=verbosity,
+                    output_file=output_file,
+                )
+            )
+            continue
+        if options.scheduler == "device":
+            from .models.device_search import device_search_one_output
+
+            results.append(
+                device_search_one_output(
+                    dataset,
+                    options,
+                    niterations,
+                    rng,
+                    saved_state=saved[j] if saved is not None else None,
+                    verbosity=verbosity,
+                    output_file=output_file,
+                )
+            )
+            continue
         results.append(
             _search_one_output(
                 dataset,

@@ -1,0 +1,55 @@
+"""Async island scheduler (scheduler="async") — recovery + merge behavior."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+
+
+def test_async_recovers_planted_equation():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 100)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=6,
+        population_size=20,
+        ncycles_per_iteration=80,
+        maxsize=15,
+        save_to_file=False,
+        seed=0,
+        scheduler="async",
+    )
+    res = equation_search(X, y, options=opts, niterations=6, verbosity=0)
+    # async completion order is nondeterministic — assert solid progress
+    # over the ~4.0 baseline-predictor loss, not a tight recovery bar
+    assert min(m.loss for m in res.pareto_frontier) < 1.5
+    assert res.num_evals > 0
+    # all islands survived with full populations
+    assert len(res.populations) == 6
+    assert all(p.n == 20 for p in res.populations)
+
+
+def test_async_early_stop():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2, 80)).astype(np.float32)
+    y = X[0].astype(np.float32)  # trivially recoverable
+    opts = Options(
+        binary_operators=["+", "-", "*"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=30,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+        scheduler="async",
+        early_stop_condition=1e-6,
+    )
+    res = equation_search(X, y, options=opts, niterations=50, verbosity=0)
+    assert res.stop_reason == "early_stop"
+
+
+def test_unknown_scheduler_rejected():
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        Options(scheduler="devive")

@@ -1,0 +1,95 @@
+"""SRRegressor / MultitargetSRRegressor — round-trip tests mirroring the
+reference's MLJ interface suite (/root/reference/test/test_mlj.jl)."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import MultitargetSRRegressor, SRRegressor
+
+
+def _opts():
+    return dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=5,
+        population_size=20,
+        ncycles_per_iteration=60,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+    )
+
+
+def test_fit_predict_roundtrip():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(120, 2)).astype(np.float32)
+    y = (2 * np.cos(X[:, 1]) + X[:, 0] ** 2 - 2).astype(np.float32)
+    m = SRRegressor(niterations=4, **_opts())
+    assert m.fit(X, y) is m
+    pred = m.predict(X)
+    assert pred.shape == (120,)
+    assert np.isfinite(pred).all()
+    assert m.score(X, y) > 0.3
+    rows = m.equations_
+    assert rows and {"complexity", "loss", "score", "equation"} <= set(rows[0])
+    rep = m.full_report()
+    assert rep["best_idx"] is not None
+    assert len(rep["equations"]) == len(rows)
+
+
+def test_predict_idx_selects_complexity():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(100, 2)).astype(np.float32)
+    y = (X[:, 0] * 2).astype(np.float32)
+    m = SRRegressor(niterations=3, **_opts())
+    m.fit(X, y)
+    rows = m.equations_
+    c = rows[0]["complexity"]
+    member = m.get_best(idx=c)
+    assert member.get_complexity(m.options_) == c
+
+
+def test_warm_start_resumes():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(100, 2)).astype(np.float32)
+    y = (2 * np.cos(X[:, 1]) + X[:, 0] ** 2 - 2).astype(np.float32)
+    m = SRRegressor(niterations=2, warm_start=True, **_opts())
+    m.fit(X, y)
+    loss1 = min(r["loss"] for r in m.equations_)
+    m.fit(X, y)  # resumes from state_
+    loss2 = min(r["loss"] for r in m.equations_)
+    assert loss2 <= loss1 + 1e-9
+
+
+def test_multitarget():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(90, 2)).astype(np.float32)
+    Y = np.stack([X[:, 0] * 2, np.cos(X[:, 1])], axis=1).astype(np.float32)
+    m = MultitargetSRRegressor(niterations=3, **_opts())
+    m.fit(X, Y)
+    pred = m.predict(X)
+    assert pred.shape == (90, 2)
+    reports = m.equations_
+    assert len(reports) == 2
+    full = m.full_report()
+    assert len(full["outputs"]) == 2
+
+
+def test_sklearn_params_protocol():
+    m = SRRegressor(niterations=3, maxsize=12, populations=4, save_to_file=False)
+    params = m.get_params()
+    assert params["niterations"] == 3 and params["maxsize"] == 12
+    m.set_params(niterations=5, maxsize=10)
+    assert m.niterations == 5 and m.maxsize == 10
+    with pytest.raises(TypeError):
+        SRRegressor(niterationz=3)
+
+
+def test_shape_validation():
+    m = SRRegressor(niterations=1, save_to_file=False)
+    X = np.zeros((10, 2))
+    with pytest.raises(ValueError, match="Multitarget"):
+        m.fit(X, np.zeros((10, 2)))
+    mt = MultitargetSRRegressor(niterations=1, save_to_file=False)
+    with pytest.raises(ValueError, match="n_outputs"):
+        mt.fit(X, np.zeros(10))

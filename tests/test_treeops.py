@@ -1,0 +1,114 @@
+"""Vectorized postorder tree-surgery primitives (ops/treeops.py) vs the host
+Node implementation as oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.models.population import Population
+from symbolicregression_jl_tpu.ops.flat import FlatTrees, flatten_trees, unflatten_tree
+from symbolicregression_jl_tpu.ops.treeops import (
+    Tree,
+    extract_block,
+    random_tree,
+    replace_range,
+    subtree_sizes,
+    tree_depth,
+)
+
+N = 32
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/"],
+    unary_operators=["cos", "exp"],
+    maxsize=30,
+    save_to_file=False,
+)
+
+_rt = jax.jit(random_tree, static_argnums=(2, 3, 4, 5))
+_sizes = jax.jit(subtree_sizes)
+_depth = jax.jit(tree_depth)
+_extract = jax.jit(extract_block)
+_replace = jax.jit(replace_range)
+
+
+def _to_ft(t: Tree) -> FlatTrees:
+    return FlatTrees(
+        np.asarray(t.kind)[None], np.asarray(t.op)[None], np.asarray(t.lhs)[None],
+        np.asarray(t.rhs)[None], np.asarray(t.feat)[None], np.asarray(t.val)[None],
+        np.asarray([t.length]),
+    )
+
+
+def _get_tree(flat: FlatTrees, p: int) -> Tree:
+    return Tree(
+        jnp.asarray(flat.kind[p]), jnp.asarray(flat.op[p]), jnp.asarray(flat.lhs[p]),
+        jnp.asarray(flat.rhs[p]), jnp.asarray(flat.feat[p]), jnp.asarray(flat.val[p]),
+        jnp.asarray(flat.length[p]),
+    )
+
+
+def test_random_tree_validity():
+    for i in range(60):
+        t = _rt(jax.random.PRNGKey(i), 1 + i % 20, N, 5, 2, 4)
+        L = int(t.length)
+        node = unflatten_tree(_to_ft(t), 0)  # raises on malformed structure
+        assert node.count_nodes() == L >= 1
+
+
+def test_random_tree_no_unary_odd_sizes():
+    for i in range(20):
+        t = _rt(jax.random.PRNGKey(100 + i), 1 + i % 20, N, 5, 0, 4)
+        assert int(t.length) % 2 == 1
+        unflatten_tree(_to_ft(t), 0)
+
+
+def test_subtree_sizes_and_depth_match_host():
+    rng = np.random.default_rng(0)
+    trees = Population.random_trees(30, OPTS, 5, rng)
+    flat = flatten_trees(trees, N)
+    for p in range(30):
+        t = _get_tree(flat, p)
+        sizes = np.asarray(_sizes(t))
+        for i, n in enumerate(trees[p].postorder()):
+            assert sizes[i] == n.count_nodes()
+        assert int(_depth(t)) == trees[p].count_depth()
+
+
+def test_replace_range_identity():
+    rng = np.random.default_rng(1)
+    trees = Population.random_trees(30, OPTS, 5, rng)
+    flat = flatten_trees(trees, N)
+    for p in range(30):
+        t = _get_tree(flat, p)
+        sizes = _sizes(t)
+        L = int(t.length)
+        pnode = int(rng.integers(0, L))
+        a = jnp.asarray(pnode) - sizes[pnode] + 1
+        b = jnp.asarray(pnode + 1)
+        t2 = _replace(t, a, b, _extract(t, a, b))
+        assert int(t2.length) == L
+        for name in ("kind", "op", "lhs", "rhs", "feat"):
+            va = np.asarray(getattr(t, name))[:L]
+            vb = np.asarray(getattr(t2, name))[:L]
+            assert (va == vb).all(), (p, name)
+
+
+def test_replace_range_with_random_material():
+    rng = np.random.default_rng(2)
+    trees = Population.random_trees(30, OPTS, 5, rng)
+    flat = flatten_trees(trees, N)
+    for p in range(30):
+        t = _get_tree(flat, p)
+        sizes = _sizes(t)
+        L = int(t.length)
+        pnode = int(rng.integers(0, L))
+        sz = int(sizes[pnode])
+        mat = _rt(jax.random.PRNGKey(p), 1 + p % 7, N, 5, 2, 4)
+        newL = L - sz + int(mat.length)
+        if newL > N:
+            continue
+        t2 = _replace(t, jnp.asarray(pnode - sz + 1), jnp.asarray(pnode + 1), mat)
+        assert int(t2.length) == newL
+        node = unflatten_tree(_to_ft(t2), 0)  # structural validity
+        assert node.count_nodes() == newL
