@@ -62,6 +62,17 @@ class Dataset:
         else:
             self.avg_y = float(np.mean(self.y))
         self._device_cache: dict = {}
+        # parse units into rational-exponent SI quantities (reference:
+        # /root/reference/src/InterfaceDynamicQuantities.jl:24-66)
+        from .units import parse_unit, parse_units_vector
+
+        self.X_units_parsed = parse_units_vector(self.X_units, self.n_features)
+        self.y_units_parsed = None if self.y_units is None else parse_unit(self.y_units)
+
+    @property
+    def has_units(self) -> bool:
+        """Reference: has_units, /root/reference/src/Dataset.jl:259-261."""
+        return self.X_units_parsed is not None or self.y_units_parsed is not None
 
     def device_arrays(self, dtype=np.float32, sharding=None):
         """(X, y, weights) as device arrays of `dtype`, cached per dtype.

@@ -33,7 +33,7 @@ from .population import Population
 __all__ = ["device_search_one_output", "device_mode_supported"]
 
 
-def device_mode_supported(options: Options) -> str | None:
+def device_mode_supported(options: Options, dataset: Dataset | None = None) -> str | None:
     """None if the device engine can honor this configuration; else a reason
     string (callers fall back to the host lockstep engine or raise)."""
     if options.loss_function is not None:
@@ -49,6 +49,10 @@ def device_mode_supported(options: Options) -> str | None:
         return "minibatching"
     if options.data_sharding is not None:
         return "dataset row sharding"
+    if dataset is not None and dataset.has_units:
+        return "dimensional analysis (units)"
+    if options.use_recorder:
+        return "recorder (mutation lineage tracing)"
     if np.dtype(options.dtype) != np.float32:
         return "non-float32 compute dtype"
     return None
@@ -312,7 +316,7 @@ def device_search_one_output(
     from ..search import SearchResult  # late import (module cycle)
     from ..utils.export_csv import save_hall_of_fame
 
-    reason = device_mode_supported(options)
+    reason = device_mode_supported(options, dataset)
     if reason is not None:
         raise ValueError(
             f"scheduler='device' cannot honor this configuration ({reason}); "

@@ -92,14 +92,21 @@ def apply_pass(
     stats: RunningSearchStatistics,
     options,
     rng: np.random.Generator,
+    recorder=None,
 ) -> list:
     """Accept/reject each scored event and replace oldest members.
-    Returns the list of newly inserted members."""
+    Returns the list of newly inserted members. With a recorder, logs
+    mutate events on the winner's lineage and death events for replaced
+    members (reference: /root/reference/src/RegularizedEvolution.jl:55-83)."""
     new_members = []
     for ev in events:
         if isinstance(ev, Proposal):
-            baby, _accepted = accept_mutation(ev, temperature, stats, options, rng)
-            pop.members[pop.oldest_index()] = baby
+            baby, accepted = accept_mutation(ev, temperature, stats, options, rng)
+            oldest = pop.oldest_index()
+            if recorder is not None:
+                recorder.record_mutation(ev.parent, baby, ev.kind, accepted, options)
+                recorder.record_death(pop.members[oldest], options)
+            pop.members[oldest] = baby
             new_members.append(baby)
         else:
             c1, c2, _accepted = accept_crossover(ev, options)

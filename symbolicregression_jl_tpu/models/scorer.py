@@ -66,6 +66,13 @@ class BatchScorer:
         self.num_evals = 0.0
         # the async island scheduler scores from worker threads
         self._evals_lock = threading.Lock()
+        self._units_penalty = None
+        if dataset.has_units:
+            self._units_penalty = (
+                1000.0
+                if options.dimensional_constraint_penalty is None
+                else float(options.dimensional_constraint_penalty)
+            )
 
     def _setup_row_sharding(self) -> None:
         """Shard the dataset rows across all devices and route full-data
@@ -110,6 +117,8 @@ class BatchScorer:
         device computes and the readback is in flight."""
         if not trees:
             return lambda: np.zeros((0,))
+        if self.options.loss_function is not None:
+            return self._custom_objective(trees, idx)
         P = len(trees)
         bucket = _bucket(P)
         padded = trees + [trees[0]] * (bucket - P)
@@ -153,7 +162,45 @@ class BatchScorer:
             pass
 
         def materialize() -> np.ndarray:
-            return np.asarray(dev_losses)[:P].astype(np.float64)
+            losses = np.asarray(dev_losses)[:P].astype(np.float64)
+            if self._units_penalty is not None:
+                from ..dimensional_analysis import violates_dimensional_constraints
+
+                viol = np.fromiter(
+                    (
+                        violates_dimensional_constraints(t, self.dataset, self.options)
+                        for t in trees[:P]
+                    ),
+                    dtype=bool,
+                    count=P,
+                )
+                # dimensional regularization: additive penalty, not rejection
+                # (/root/reference/src/LossFunctions.jl:217-227)
+                losses = losses + viol * self._units_penalty
+            return losses
+
+        return materialize
+
+    def _custom_objective(self, trees: list[Node], idx):
+        """Full-objective dispatch: the user's ``loss_function(tree, dataset,
+        options)`` replaces elementwise eval entirely (reference:
+        /root/reference/src/LossFunctions.jl:78-94; exercised by
+        test_custom_objectives.jl). Host-side by nature — the objective sees
+        the raw tree."""
+        P = len(trees)
+        with self._evals_lock:
+            self.num_evals += P if idx is None else P * (len(idx) / self.dataset.n)
+        fn = self.options.loss_function
+
+        def materialize() -> np.ndarray:
+            out = np.empty(P, dtype=np.float64)
+            for k, t in enumerate(trees):
+                try:
+                    v = float(fn(t, self.dataset, self.options))
+                except Exception:  # noqa: BLE001 — invalid tree => inf loss
+                    v = np.inf
+                out[k] = v if np.isfinite(v) or v == np.inf else np.inf
+            return out
 
         return materialize
 
@@ -161,6 +208,24 @@ class BatchScorer:
         """Full-data (or row-subset) losses for a batch of trees. Returns
         float64 numpy [len(trees)]; inf = invalid candidate."""
         return self.loss_many_async(trees, idx=idx)()
+
+    def apply_units_penalty(self, trees: list[Node], losses: np.ndarray) -> np.ndarray:
+        """Add the dimensional-regularization penalty to externally-computed
+        losses (e.g. the constant optimizer's) so unit-violating trees cannot
+        enter populations/hall-of-fame un-penalized."""
+        if self._units_penalty is None or not len(trees):
+            return losses
+        from ..dimensional_analysis import violates_dimensional_constraints
+
+        viol = np.fromiter(
+            (
+                violates_dimensional_constraints(t, self.dataset, self.options)
+                for t in trees
+            ),
+            dtype=bool,
+            count=len(trees),
+        )
+        return np.asarray(losses) + viol * self._units_penalty
 
     def batch_indices(self, rng: np.random.Generator) -> np.ndarray | None:
         """With-replacement minibatch row indices (reference: batch_sample,

@@ -43,6 +43,7 @@ def s_r_cycle_lockstep(
     nfeatures: int,
     rng: np.random.Generator,
     pipeline_depth: int = 4,
+    recorder=None,
 ) -> list[HallOfFame]:
     """Run `ncycles` evolve passes on every island; returns per-island
     best-seen halls of fame (the reference's `return_best_seen` path).
@@ -85,7 +86,7 @@ def s_r_cycle_lockstep(
             fill_scores(
                 events, scores[start : start + count], losses[start : start + count]
             )
-            new_members = apply_pass(pop, events, T, stats, options, rng)
+            new_members = apply_pass(pop, events, T, stats, options, rng, recorder)
             # best-seen update: newly inserted members may set a
             # per-complexity record (reference tracks best_seen during the
             # cycle, /root/reference/src/SingleIteration.jl:42-101)
@@ -137,6 +138,7 @@ def optimize_and_simplify_populations(
     scorer: BatchScorer,
     options,
     rng: np.random.Generator,
+    recorder=None,
 ) -> None:
     """Simplify every member, then constant-optimize a
     `optimizer_probability` subset — batched across all islands — then
@@ -165,18 +167,25 @@ def optimize_and_simplify_populations(
             new_trees, losses, improved = optimize_constants_batched(
                 trees, scorer, options, rng, idx=idx
             )
+            # re-apply dimensional regularization: the optimizer's device
+            # losses are raw elementwise losses
+            losses = scorer.apply_units_penalty(new_trees, losses)
             comps = [compute_complexity(t, options) for t in new_trees]
             scores = scorer.score_of(losses, np.asarray(comps))
             for (pop, k), tree, loss, score, imp in zip(
                 selected, new_trees, losses, scores, improved
             ):
+                m = pop.members[k]
                 if imp:
-                    m = pop.members[k]
                     m.set_tree(tree)
                     m.loss = float(loss)
                     m.score = float(score)
                     m.get_complexity(options)
                     m.reset_birth()
+                if recorder is not None:
+                    # constant-opt "tuning" events
+                    # (reference: SingleIteration.jl:140-171)
+                    recorder.record_tuning(m, bool(imp), options)
 
     # 3) finalize: full-data rescore when batching (reference: finalize_scores,
     #    /root/reference/src/Population.jl:162-176)

@@ -94,20 +94,125 @@ def _bfgs_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
     return x, f
 
 
+def _newton_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
+    """Newton + backtracking on a SINGLE masked constant (the reference's
+    1-constant special case, /root/reference/src/ConstantOptimization.jl:22-41).
+    Curvature via a Hessian-vector product along the masked direction."""
+    e = mask.astype(val0.dtype)
+
+    def f(v):
+        return loss_fn(v, structure, X, y, w, has_w)
+
+    def body(carry, _):
+        x, fx = carry
+        g = jnp.vdot(jax.grad(f)(x), e)
+        h = jnp.vdot(jax.jvp(jax.grad(f), (x,), (e,))[1], e)
+        step = jnp.where(jnp.abs(h) > 1e-30, -g / h, -g)
+        step = jnp.where(jnp.isfinite(step), step, 0.0)
+
+        def ls_cond(state):
+            alpha, f_new, k = state
+            return (~(f_new < fx)) & (k < 8)
+
+        def ls_body(state):
+            alpha, _, k = state
+            alpha = alpha * 0.5
+            return alpha, f(x + alpha * step * e), k + 1
+
+        f_try = f(x + step * e)
+        alpha, f_new, _ = lax.while_loop(
+            ls_cond, ls_body, (jnp.asarray(1.0, val0.dtype), f_try, 0)
+        )
+        ok = jnp.isfinite(f_new) & (f_new < fx)
+        x_new = jnp.where(ok, x + alpha * step * e, x)
+        return (x_new, jnp.where(ok, f_new, fx)), None
+
+    f0 = f(val0)
+    (x, fx), _ = lax.scan(body, (val0, f0), None, length=iters)
+    return x, fx
+
+
+def _neldermead_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
+    """Masked Nelder–Mead simplex (the reference's configurable alternative,
+    /root/reference/src/Options.jl:522-532). Non-constant slots stay pinned."""
+    N = val0.shape[0]
+    dtype = val0.dtype
+    mf = mask.astype(dtype)
+
+    def f(v):
+        return loss_fn(v, structure, X, y, w, has_w)
+
+    # initial simplex: val0 plus one perturbed vertex per (masked) coordinate
+    steps = jnp.where(val0 != 0, 0.05 * val0, 0.00025) * mf
+    verts = jnp.concatenate([val0[None], val0[None] + jnp.diag(steps)], axis=0)
+    fvals = jax.vmap(f)(verts)
+    fvals = jnp.where(jnp.isfinite(fvals), fvals, jnp.inf)
+
+    def body(carry, _):
+        verts, fvals = carry
+        order = jnp.argsort(fvals)
+        verts = verts[order]
+        fvals = fvals[order]
+        best, worst = verts[0], verts[-1]
+        centroid = jnp.mean(verts[:-1], axis=0)
+        refl = centroid + (centroid - worst) * mf
+        f_r = f(refl)
+        exp_ = centroid + 2.0 * (centroid - worst) * mf
+        f_e = f(exp_)
+        cont = centroid - 0.5 * (centroid - worst) * mf
+        f_c = f(cont)
+
+        use_exp = (f_r < fvals[0]) & (f_e < f_r)
+        use_refl = (f_r < fvals[-2]) & ~use_exp
+        use_cont = (~use_exp) & (~use_refl) & (f_c < fvals[-1])
+        new_v = jnp.where(
+            use_exp, exp_, jnp.where(use_refl, refl, jnp.where(use_cont, cont, worst))
+        )
+        new_f = jnp.where(
+            use_exp, f_e, jnp.where(use_refl, f_r, jnp.where(use_cont, f_c, fvals[-1]))
+        )
+        shrink = (~use_exp) & (~use_refl) & (~use_cont)
+
+        verts2 = verts.at[-1].set(new_v)
+        fvals2 = fvals.at[-1].set(new_f)
+        # shrink toward best when nothing helped
+        sv = best[None] + 0.5 * (verts - best[None]) * mf[None]
+        sf = jax.vmap(f)(sv)
+        verts3 = jnp.where(shrink, sv, verts2)
+        fvals3 = jnp.where(shrink, jnp.where(jnp.isfinite(sf), sf, jnp.inf), fvals2)
+        return (verts3, fvals3), None
+
+    (verts, fvals), _ = lax.scan(body, (verts, fvals), None, length=iters)
+    best = jnp.argmin(fvals)
+    return verts[best], fvals[best]
+
+
 @functools.partial(
-    jax.jit, static_argnames=("opset", "loss_elem", "iters", "has_w")
+    jax.jit, static_argnames=("opset", "loss_elem", "iters", "has_w", "algorithm")
 )
-def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w):
+def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS"):
     """starts: [P, S, N] initial constant vectors (S = 1 + nrestarts).
-    Returns best (val [P,N], loss [P]) over restarts per tree."""
+    Returns best (val [P,N], loss [P]) over restarts per tree.
+
+    Per reference semantics, trees with exactly ONE constant always use
+    Newton+backtracking; others use the configured algorithm
+    (/root/reference/src/ConstantOptimization.jl:22-41)."""
     loss_fn = _tree_loss_fn(opset, loss_elem)
     structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
     mask = flat.kind == KIND_CONST  # [P, N]
+    main = _bfgs_single if algorithm == "BFGS" else _neldermead_single
 
     def per_tree(struct_p, starts_p, mask_p):
+        one_const = jnp.sum(mask_p) == 1
+
         def per_restart(v0):
-            return _bfgs_single(
+            vm, fm = main(loss_fn, v0, struct_p, X, y, w, has_w, mask_p, iters)
+            vn, fn_ = _newton_single(
                 loss_fn, v0, struct_p, X, y, w, has_w, mask_p, iters
+            )
+            return (
+                jnp.where(one_const, vn, vm),
+                jnp.where(one_const, fn_, fm),
             )
 
         vals, fs = jax.vmap(per_restart)(starts_p)  # [S,N], [S]
@@ -118,6 +223,86 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w):
     return jax.vmap(per_tree)(
         _Structure(*(jnp.asarray(a) for a in structure)), starts, mask
     )
+
+
+def _optimize_constants_custom_objective(trees, scorer, options, rng):
+    """Host Nelder–Mead over each tree's constants against the user's full
+    ``loss_function`` (which sees the raw tree, so the device BFGS cannot be
+    used; the reference drives Optim with the same host objective,
+    /root/reference/src/ConstantOptimization.jl:50 + LossFunctions.jl:78-94)."""
+    fn = options.loss_function
+    ds = scorer.dataset
+    n_iters = max(20, 10 * int(options.optimizer_iterations))
+    new_trees, losses, improved = [], [], []
+    for tree in trees:
+        c0 = tree.get_constants()
+        if c0.size == 0:
+            loss0 = float(fn(tree, ds, options))
+            new_trees.append(tree)
+            losses.append(loss0)
+            improved.append(False)
+            continue
+        work = tree.copy()
+
+        def obj(c):
+            work.set_constants(c)
+            try:
+                v = float(fn(work, ds, options))
+            except Exception:  # noqa: BLE001
+                return np.inf
+            return v if np.isfinite(v) else np.inf
+
+        best_c, best_f = _host_neldermead(obj, c0, n_iters)
+        with scorer._evals_lock:
+            scorer.num_evals += n_iters * (len(c0) + 1)
+        f0 = obj(c0)
+        if best_f < f0:
+            out = tree.copy()
+            out.set_constants(best_c)
+            new_trees.append(out)
+            losses.append(best_f)
+            improved.append(True)
+        else:
+            new_trees.append(tree)
+            losses.append(f0)
+            improved.append(False)
+    return new_trees, np.asarray(losses), np.asarray(improved)
+
+
+def _host_neldermead(obj, x0: np.ndarray, iters: int):
+    """Minimal dependency-free Nelder–Mead."""
+    n = len(x0)
+    verts = [np.asarray(x0, dtype=np.float64)]
+    for i in range(n):
+        v = verts[0].copy()
+        v[i] += 0.05 * v[i] if v[i] != 0 else 0.00025
+        verts.append(v)
+    fvals = [obj(v) for v in verts]
+    for _ in range(iters):
+        order = np.argsort(fvals)
+        verts = [verts[k] for k in order]
+        fvals = [fvals[k] for k in order]
+        centroid = np.mean(verts[:-1], axis=0)
+        refl = centroid + (centroid - verts[-1])
+        f_r = obj(refl)
+        if f_r < fvals[0]:
+            exp_ = centroid + 2 * (centroid - verts[-1])
+            f_e = obj(exp_)
+            verts[-1], fvals[-1] = (exp_, f_e) if f_e < f_r else (refl, f_r)
+        elif f_r < fvals[-2]:
+            verts[-1], fvals[-1] = refl, f_r
+        else:
+            cont = centroid - 0.5 * (centroid - verts[-1])
+            f_c = obj(cont)
+            if f_c < fvals[-1]:
+                verts[-1], fvals[-1] = cont, f_c
+            else:
+                verts = [verts[0]] + [
+                    verts[0] + 0.5 * (v - verts[0]) for v in verts[1:]
+                ]
+                fvals = [fvals[0]] + [obj(v) for v in verts[1:]]
+    k = int(np.argmin(fvals))
+    return verts[k], fvals[k]
 
 
 def optimize_constants_batched(
@@ -136,6 +321,8 @@ def optimize_constants_batched(
     """
     if not trees:
         return [], np.zeros((0,)), np.zeros((0,), dtype=bool)
+    if options.loss_function is not None:
+        return _optimize_constants_custom_objective(trees, scorer, options, rng)
 
     n_real = len(trees)
     # pad the batch to a power-of-two bucket so the (large) BFGS program
@@ -162,6 +349,11 @@ def optimize_constants_batched(
     has_w = w is not None
     w_arg = w if has_w else jnp.zeros((), dtype)
 
+    iters = int(options.optimizer_iterations)
+    if options.optimizer_f_calls_limit:
+        # ~4 objective evaluations per iteration per restart (value+grad +
+        # line search); the reference passes f_calls_limit to Optim.Options
+        iters = max(1, min(iters, int(options.optimizer_f_calls_limit) // (4 * S)))
     vals, fs = _optimize_batch(
         FlatTrees(*(jnp.asarray(a) for a in flat)),
         X,
@@ -170,17 +362,19 @@ def optimize_constants_batched(
         jnp.asarray(base),
         scorer.opset,
         scorer.loss_elem,
-        int(options.optimizer_iterations),
+        iters,
         has_w,
+        algorithm=options.optimizer_algorithm,
     )
     vals = np.asarray(vals)
     fs = np.asarray(fs, dtype=np.float64)
 
     # eval accounting: ~2 evals (value+grad) per iteration per restart
     n_rows = scorer.dataset.n if idx is None else len(idx)
-    scorer.num_evals += n_real * S * 2 * options.optimizer_iterations * (
-        n_rows / scorer.dataset.n
-    )
+    with scorer._evals_lock:
+        scorer.num_evals += n_real * S * 2 * options.optimizer_iterations * (
+            n_rows / scorer.dataset.n
+        )
 
     trees = trees[:n_real]
     vals, fs = vals[:n_real], fs[:n_real]

@@ -97,6 +97,10 @@ class Options:
     complexity_of_constants: float | None = None
     complexity_of_variables: float | Sequence[float] | None = None
     parsimony: float = 0.0032
+    # loss penalty for dimensionally-inconsistent trees when the dataset has
+    # units; None -> 1000, the reference default
+    # (/root/reference/src/LossFunctions.jl:217-227)
+    dimensional_constraint_penalty: float | None = None
     use_frequency: bool = True
     use_frequency_in_tournament: bool = True
     adaptive_parsimony_scaling: float = 20.0
@@ -135,6 +139,10 @@ class Options:
     batch_size: int = 50
 
     # -- run control ---------------------------------------------------------
+    # preflight checks before searching (reference runs them by default,
+    # /root/reference/src/Configure.jl): True = operator totality + dataset
+    # validation; "full" additionally runs a miniature end-to-end pipeline
+    runtests: Any = True
     early_stop_condition: float | Callable | None = None
     timeout_in_seconds: float | None = None
     max_evals: int | None = None
@@ -178,6 +186,19 @@ class Options:
             raise ValueError(
                 f"unknown scheduler {self.scheduler!r}; "
                 "expected 'lockstep', 'device', or 'async'"
+            )
+        if self.optimizer_algorithm not in ("BFGS", "NelderMead"):
+            raise ValueError(
+                f"unsupported optimizer_algorithm {self.optimizer_algorithm!r}; "
+                "expected 'BFGS' or 'NelderMead' (1-constant trees always use "
+                "Newton, like the reference)"
+            )
+        if self.use_recorder and self.crossover_probability > 0:
+            # recorder lineage is single-parent; same constraint as the
+            # reference (/root/reference/src/RegularizedEvolution.jl:26-28)
+            raise ValueError(
+                "use_recorder requires crossover_probability=0 "
+                "(mutation lineage recording does not track two-parent events)"
             )
 
         self._op_constraints = _normalize_constraints(self.constraints, self.operators)

@@ -82,6 +82,9 @@ def async_search_one_output(
             for _ in range(n_islands)
         ]
 
+    from ..utils.recorder import Recorder
+
+    recorder = Recorder(options)
     shared_stats = RunningSearchStatistics(options.maxsize)
     # independent RNG stream per island (thread-safe, reproducible spawn)
     seeds = np.random.SeedSequence(
@@ -112,12 +115,27 @@ def async_search_one_output(
             options,
             nfeatures,
             irng,
+            recorder=recorder if recorder.enabled else None,
         )[0]
-        optimize_and_simplify_populations([pop], scorer, options, irng)
+        optimize_and_simplify_populations(
+            [pop], scorer, options, irng,
+            recorder if recorder.enabled else None,
+        )
+        if recorder.enabled:
+            with lock:
+                recorder.record_population(1, i + 1, iteration, pop, options)
         return i, pop, best_seen
+
+    from ..utils.progress import ProgressReporter
+
+    reporter = ProgressReporter(
+        niterations * n_islands, options, use_bar=bool(options.progress),
+        verbosity=verbosity,
+    )
 
     def on_complete(i: int, pop: Population, best_seen: HallOfFame):
         """Head-side merge (reference main loop :896-1006)."""
+        t_head = time.time()
         with lock:
             pops[i] = pop
             hof.merge(best_seen, options)
@@ -142,13 +160,7 @@ def async_search_one_output(
                     )
             if output_file and options.save_to_file:
                 save_hall_of_fame(output_file, hof, options, dataset.variable_names)
-            if verbosity > 0:
-                elapsed = time.time() - start_time
-                done = niterations * n_islands - sum(cycles_left)
-                print(
-                    f"[async {done}/{niterations * n_islands} units] "
-                    f"evals={scorer.num_evals:.3g} elapsed={elapsed:.1f}s"
-                )
+            reporter.update(hof, scorer.num_evals, dataset.variable_names)
             # stop conditions (reference :1053-1060)
             if early_stop is not None and any(
                 early_stop(m.loss, m.get_complexity(options))
@@ -162,6 +174,10 @@ def async_search_one_output(
                 stop_reason[0] = "timeout"
             if options.max_evals is not None and scorer.num_evals >= options.max_evals:
                 stop_reason[0] = "max_evals"
+        # head-node occupancy (reference: ResourceMonitor + >40% warning,
+        # /root/reference/src/SearchUtils.jl:217-284)
+        reporter.head_work(time.time() - t_head)
+        reporter.maybe_warn_occupancy()
 
     max_workers = min(n_islands, 8)
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
@@ -190,6 +206,7 @@ def async_search_one_output(
                     on_complete(idx, pop, best_seen)
                 break
 
+    recorder.dump()
     result = SearchResult(
         hall_of_fame=hof,
         populations=pops,

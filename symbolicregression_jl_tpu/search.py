@@ -116,6 +116,9 @@ def _search_one_output(
 ) -> SearchResult:
     scorer = BatchScorer(dataset, options)
     nfeatures = dataset.n_features
+    from .utils.recorder import Recorder
+
+    recorder = Recorder(options)
 
     # -- initialize (warm start re-scores saved members: reference
     #    _initialize_search!, /root/reference/src/SymbolicRegression.jl:722-795)
@@ -150,6 +153,11 @@ def _search_one_output(
     early_stop = options.early_stop_fn()
     start_time = time.time()
     stop_reason = None
+    from .utils.progress import ProgressReporter
+
+    reporter = ProgressReporter(
+        niterations, options, use_bar=bool(options.progress), verbosity=verbosity
+    )
 
     for iteration in range(niterations):
         curmaxsize = get_cur_maxsize(iteration, niterations, options)
@@ -163,8 +171,12 @@ def _search_one_output(
             options,
             nfeatures,
             rng,
+            recorder=recorder,
         )
-        optimize_and_simplify_populations(pops, scorer, options, rng)
+        optimize_and_simplify_populations(pops, scorer, options, rng, recorder)
+        if recorder.enabled:
+            for i, pop in enumerate(pops):
+                recorder.record_population(1, i + 1, iteration, pop, options)
 
         # merge halls of fame + frequency stats (head-side merge in the
         # reference main loop, /root/reference/src/SymbolicRegression.jl:916-926)
@@ -194,14 +206,12 @@ def _search_one_output(
         if output_file and options.save_to_file:
             save_hall_of_fame(output_file, hof, options, dataset.variable_names)
 
-        if verbosity > 0:
-            elapsed = time.time() - start_time
-            print(
-                f"[iter {iteration + 1}/{niterations}] "
-                f"evals={scorer.num_evals:.3g} elapsed={elapsed:.1f}s "
-                f"evals/s={scorer.num_evals / max(elapsed, 1e-9):.3g}"
-            )
-            print(hof.render(options, dataset.variable_names))
+        reporter.update(
+            hof,
+            scorer.num_evals,
+            dataset.variable_names,
+            force=iteration == niterations - 1,
+        )
 
         # stop conditions (reference: /root/reference/src/SearchUtils.jl:190-212)
         if early_stop is not None and any(
@@ -220,6 +230,7 @@ def _search_one_output(
             stop_reason = "max_evals"
             break
 
+    recorder.dump()
     result = SearchResult(
         hall_of_fame=hof,
         populations=pops,
@@ -279,6 +290,14 @@ def equation_search(
     verbosity = 1 if verbosity is None else verbosity
     rng = np.random.default_rng(options.seed)
 
+    # preflight (reference: _validate_options, /root/reference/src/SymbolicRegression.jl:604-633)
+    if options.runtests:
+        from .configure import test_mini_pipeline, test_option_configuration
+
+        test_option_configuration(options)
+        if options.runtests == "full":
+            test_mini_pipeline(options)
+
     saved = saved_state
     if saved is not None and not isinstance(saved, (list, tuple)):
         saved = [saved]
@@ -293,6 +312,10 @@ def equation_search(
             X_units=X_units,
             y_units=y_units[j] if isinstance(y_units, (list, tuple)) else y_units,
         )
+        if options.runtests:
+            from .configure import test_dataset_configuration
+
+            test_dataset_configuration(dataset, options, verbosity)
         output_file = None
         if options.save_to_file:
             base = options.output_file or f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"

@@ -19,7 +19,10 @@ def save_hall_of_fame(path: str, hof, options, variable_names=None) -> None:
         eq = r["equation"].replace('"', '""')
         lines.append(f'{r["complexity"]},{r["loss"]:.16g},"{eq}"')
     content = "\n".join(lines) + "\n"
-    bkup = path + ".bkup"
-    with open(bkup, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         f.write(content)
-    os.replace(bkup, path)
+    os.replace(tmp, path)
+    # persistent .bkup copy survives a crash mid-write of the main file
+    with open(path + ".bkup", "w") as f:
+        f.write(content)

@@ -1,0 +1,64 @@
+"""CAS export round-trip (reference: SymbolicUtils ext)."""
+
+import numpy as np
+import pytest
+
+sympy = pytest.importorskip("sympy")
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.export_sympy import node_to_sympy, sympy_to_node
+from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/", "pow"],
+    unary_operators=["cos", "sqrt", "square"],
+    save_to_file=False,
+)
+ADD, SUB, MUL, DIV, POW = range(5)
+COS, SQRT, SQUARE = range(3)
+
+
+def test_node_to_sympy_structure():
+    # 2*cos(x2) + x1^2 - 2
+    t = binary(
+        SUB,
+        binary(
+            ADD,
+            binary(MUL, constant(2.0), unary(COS, feature(1))),
+            unary(SQUARE, feature(0)),
+        ),
+        constant(2.0),
+    )
+    e = node_to_sympy(t, OPTS.operators)
+    x1, x2 = sympy.symbols("x1 x2")
+    expected = 2 * sympy.cos(x2) + x1**2 - 2
+    assert sympy.simplify(e - expected) == 0
+
+
+def test_roundtrip_evaluates_identically():
+    t = binary(
+        ADD,
+        binary(MUL, constant(1.5), feature(0)),
+        unary(COS, binary(MUL, constant(2.0), feature(1))),
+    )
+    e = node_to_sympy(t, OPTS.operators)
+    back = sympy_to_node(e, OPTS.operators)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 50))
+    np.testing.assert_allclose(
+        t.eval_np(X, OPTS.operators), back.eval_np(X, OPTS.operators), rtol=1e-6
+    )
+
+
+def test_sympy_to_node_from_string():
+    t = sympy_to_node("x1 * 3 + cos(x2)", OPTS.operators)
+    X = np.array([[1.0, 2.0], [0.5, 0.2]])
+    np.testing.assert_allclose(
+        t.eval_np(X, OPTS.operators), 3 * X[0] + np.cos(X[1]), rtol=1e-6
+    )
+
+
+def test_unmapped_operator_raises():
+    small = Options(binary_operators=["+"], save_to_file=False)
+    with pytest.raises(ValueError):
+        sympy_to_node("cos(x1)", small.operators)

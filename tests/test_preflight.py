@@ -1,0 +1,92 @@
+"""Preflight checks + observability plumbing (reference: Configure.jl)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Dataset, Options, equation_search
+from symbolicregression_jl_tpu.configure import (
+    test_dataset_configuration as check_dataset,
+    test_mini_pipeline as run_mini_pipeline,
+    test_option_configuration as check_options,
+)
+
+# pytest would otherwise try to collect the imported check functions
+check_dataset.__test__ = False
+check_options.__test__ = False
+run_mini_pipeline.__test__ = False
+
+
+def test_operator_totality_passes_builtins():
+    check_options(
+        Options(
+            binary_operators=["+", "-", "*", "/", "pow"],
+            unary_operators=["cos", "log", "sqrt", "exp"],
+            save_to_file=False,
+        )
+    )
+
+
+def test_raising_custom_operator_rejected():
+    def bad_partial_op(x):
+        raise RuntimeError("partial operator")
+
+    opts = Options(
+        binary_operators=["+"],
+        unary_operators=[bad_partial_op],
+        save_to_file=False,
+        runtests=False,
+    )
+    with pytest.raises(ValueError, match="not total"):
+        check_options(opts)
+
+
+def test_dataset_validation():
+    opts = Options(binary_operators=["+"], save_to_file=False)
+    X = np.ones((2, 10), np.float32)
+    ds = Dataset(X, np.ones(10, np.float32))
+    check_dataset(ds, opts, verbosity=0)
+    bad = Dataset(X, np.full(10, np.nan, np.float32))
+    with pytest.raises(ValueError, match="non-finite"):
+        check_dataset(bad, opts, verbosity=0)
+
+
+def test_batching_hint_on_large_dataset():
+    opts = Options(binary_operators=["+"], save_to_file=False)
+    X = np.ones((1, 10_001), np.float32)
+    ds = Dataset(X, np.ones(10_001, np.float32))
+    with pytest.warns(UserWarning, match="batching"):
+        check_dataset(ds, opts, verbosity=1)
+
+
+def test_mini_pipeline_runs():
+    run_mini_pipeline(
+        Options(
+            binary_operators=["+", "*"],
+            unary_operators=["cos"],
+            save_to_file=False,
+        )
+    )
+
+
+def test_csv_bkup_double_write(tmp_path):
+    out = str(tmp_path / "hof.csv")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1, 40)).astype(np.float32)
+    y = (2 * X[0]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "*"],
+        populations=2,
+        population_size=10,
+        ncycles_per_iteration=10,
+        save_to_file=True,
+        output_file=out,
+        seed=0,
+    )
+    equation_search(X, y, options=opts, niterations=1, verbosity=0)
+    assert os.path.exists(out)
+    assert os.path.exists(out + ".bkup")
+    with open(out) as fh:
+        header = fh.readline().strip()
+    assert header == "Complexity,Loss,Equation"

@@ -1,0 +1,78 @@
+"""Recorder JSON schema test (mirrors /root/reference/test/test_recorder.jl)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+
+
+def test_recorder_schema(tmp_path):
+    rec_file = str(tmp_path / "recorder.json")
+    rng = np.random.default_rng(0)
+    X = (2 * rng.normal(size=(2, 200))).astype(np.float32)
+    y = (3 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "*", "/", "-"],
+        unary_operators=["cos"],
+        use_recorder=True,
+        recorder_file=rec_file,
+        crossover_probability=0.0,  # required for recording, like the reference
+        populations=2,
+        population_size=30,
+        ncycles_per_iteration=40,
+        maxsize=16,
+        save_to_file=False,
+        seed=0,
+    )
+    equation_search(X, y, options=options, niterations=3, verbosity=0)
+
+    assert os.path.exists(rec_file)
+    with open(rec_file) as fh:
+        data = json.load(fh)
+
+    assert "options" in data and "Options" in data["options"]
+    assert "out1_pop1" in data and "out1_pop2" in data
+    assert "mutations" in data and len(data["mutations"]) > 50
+    # snapshots per iteration
+    assert "iteration0" in data["out1_pop1"]
+    for i, (ref, entry) in enumerate(data["mutations"].items()):
+        assert "events" in entry
+        assert "score" in entry
+        assert "tree" in entry
+        assert "loss" in entry
+        assert "parent" in entry
+        if i > 10:
+            break
+    # at least one mutate and one death event exist
+    kinds = {
+        ev["type"]
+        for entry in data["mutations"].values()
+        for ev in entry["events"]
+    }
+    assert "mutate" in kinds and "death" in kinds
+
+
+def test_recorder_requires_no_crossover():
+    with pytest.raises(ValueError, match="crossover"):
+        Options(use_recorder=True, crossover_probability=0.1)
+
+
+def test_recorder_off_writes_nothing(tmp_path):
+    rec_file = str(tmp_path / "rec.json")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 50)).astype(np.float32)
+    y = X[0].astype(np.float32)
+    options = Options(
+        binary_operators=["+", "*"],
+        populations=2,
+        population_size=12,
+        ncycles_per_iteration=10,
+        recorder_file=rec_file,
+        save_to_file=False,
+        seed=0,
+    )
+    equation_search(X, y, options=options, niterations=1, verbosity=0)
+    assert not os.path.exists(rec_file)

@@ -1,0 +1,128 @@
+"""Units parsing + dimensional analysis (spirit of
+/root/reference/test/test_units.jl)."""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Dataset, Options, equation_search
+from symbolicregression_jl_tpu.dimensional_analysis import (
+    violates_dimensional_constraints,
+)
+from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+from symbolicregression_jl_tpu.units import DIMENSIONLESS, parse_unit
+
+
+class TestParsing:
+    def test_base_units(self):
+        q = parse_unit("m")
+        assert q.value == 1.0 and q.dims.length == 1
+
+    def test_compound(self):
+        q = parse_unit("kg*m^2/s^2")  # joule
+        assert q.dims == parse_unit("J").dims
+        assert q.value == pytest.approx(1.0)
+
+    def test_prefixes_scale(self):
+        assert parse_unit("km").value == pytest.approx(1000.0)
+        assert parse_unit("mm").value == pytest.approx(1e-3)
+        assert parse_unit("km/s").dims.time == -1
+
+    def test_rational_exponents(self):
+        q = parse_unit("m^(1//2)")
+        assert q.dims.length == Fraction(1, 2)
+
+    def test_dimensionless(self):
+        assert parse_unit("1").dims.dimensionless
+        assert parse_unit(None).dims == DIMENSIONLESS
+
+    def test_unknown_unit(self):
+        with pytest.raises(ValueError):
+            parse_unit("florp")
+
+
+def _ds(X_units=None, y_units=None):
+    rng = np.random.default_rng(0)
+    X = np.abs(rng.normal(size=(2, 30))) + 0.5
+    y = 2.0 * X[0]
+    return Dataset(X.astype(np.float32), y.astype(np.float32),
+                   X_units=X_units, y_units=y_units)
+
+
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/"],
+    unary_operators=["cos", "sqrt"],
+    save_to_file=False,
+)
+ADD, SUB, MUL, DIV = 0, 1, 2, 3
+COS, SQRT = 0, 1
+
+
+class TestDimensionalAnalysis:
+    def test_no_units_never_violates(self):
+        ds = _ds()
+        t = unary(COS, feature(0))
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+
+    def test_add_mismatched_dims_violates(self):
+        ds = _ds(X_units=["m", "s"])
+        t = binary(ADD, feature(0), feature(1))  # m + s
+        assert violates_dimensional_constraints(t, ds, OPTS)
+
+    def test_constant_wildcard_absorbs(self):
+        ds = _ds(X_units=["m", "s"])
+        t = binary(ADD, feature(0), constant(1.5))  # m + c: c absorbs meters
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+
+    def test_generic_unary_needs_dimensionless(self):
+        ds = _ds(X_units=["m", "s"])
+        assert violates_dimensional_constraints(unary(COS, feature(0)), ds, OPTS)
+        # x1 / x1 is dimensionless -> cos fine
+        ratio = binary(DIV, feature(0), feature(0))
+        assert not violates_dimensional_constraints(unary(COS, ratio), ds, OPTS)
+
+    def test_sqrt_halves_dims(self):
+        ds = _ds(X_units=["m^2", "s"], y_units="m")
+        t = unary(SQRT, feature(0))  # sqrt(m^2) = m: matches y
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+        t2 = feature(0)  # m^2 != m
+        assert violates_dimensional_constraints(t2, ds, OPTS)
+
+    def test_y_units_checked(self):
+        ds = _ds(X_units=["m", "s"], y_units="m/s")
+        ok = binary(DIV, feature(0), feature(1))  # m/s
+        bad = binary(MUL, feature(0), feature(1))  # m*s
+        assert not violates_dimensional_constraints(ok, ds, OPTS)
+        assert violates_dimensional_constraints(bad, ds, OPTS)
+
+    def test_mult_combines_dims(self):
+        ds = _ds(X_units=["m", "m"], y_units="m^2")
+        t = binary(MUL, feature(0), feature(1))
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+
+
+def test_search_with_units_penalizes_violations():
+    """Planted y = 2*x1 with x1 in meters, y in meters: the dimensional
+    penalty must steer the search to unit-consistent equations."""
+    rng = np.random.default_rng(0)
+    X = (np.abs(rng.normal(size=(2, 80))) + 0.5).astype(np.float32)
+    y = (2.0 * X[0]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+    )
+    res = equation_search(
+        X, y, options=opts, niterations=3, verbosity=0,
+        X_units=["m", "s"], y_units="m",
+    )
+    best = min(res.pareto_frontier, key=lambda m: m.loss)
+    # the recovered equation must itself be dimensionally consistent
+    assert not violates_dimensional_constraints(best.tree, res.dataset, opts)
+    assert best.loss < 1000.0  # no penalty baked into the winner
