@@ -12,12 +12,27 @@ from .tree import Node
 __all__ = ["compute_complexity", "past_complexity_limit"]
 
 
+def _iter_nodes(tree: Node, unique: bool):
+    if not unique:
+        yield from tree
+        return
+    seen: set[int] = set()
+    for n in tree:
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        yield n
+
+
 def compute_complexity(tree: Node, options) -> int:
+    # GraphNode mode: shared subtrees count ONCE (reference:
+    # shared-node-aware tree_mapreduce, Complexity.jl:17-50)
+    unique = bool(getattr(options, "graph_nodes", False))
     mapping = options.complexity_mapping
     if mapping is None:
-        return tree.count_nodes()
+        return tree.count_unique_nodes() if unique else tree.count_nodes()
     total = 0.0
-    for n in tree:
+    for n in _iter_nodes(tree, unique):
         if n.degree == 0:
             if n.is_const:
                 total += mapping["constant"]

@@ -78,11 +78,14 @@ def check_constraints(
     size = compute_complexity(tree, options) if cursize is None else cursize
     if size > maxsize:
         return False
-    # Hard raw-node cap: the device tensors are sized to options.max_nodes, and
-    # with per-node complexities < 1 (or <= 0) the complexity check above does
-    # not bound node count (options.py sizes max_nodes accordingly). Skipped
-    # entirely when complexity >= 1 per node, where size <= maxsize implies it.
-    if options._needs_node_cap and tree.count_nodes() > options.max_nodes:
+    # Hard raw-node cap: the device tensors are sized to options.max_nodes.
+    # Load-bearing when per-node complexities < 1 (complexity cannot bound
+    # node count; options.py sizes max_nodes accordingly) and in GraphNode
+    # mode (complexity counts shared subtrees once but device flattening
+    # EXPANDS sharing). Skipped otherwise: size <= maxsize implies the cap.
+    if (options._needs_node_cap or options.graph_nodes) and (
+        tree.count_nodes() > options.max_nodes
+    ):
         return False
     if tree.count_depth() > options.maxdepth:
         return False

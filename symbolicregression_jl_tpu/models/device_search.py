@@ -53,6 +53,8 @@ def device_mode_supported(options: Options, dataset: Dataset | None = None) -> s
         return "dimensional analysis (units)"
     if options.use_recorder:
         return "recorder (mutation lineage tracing)"
+    if options.graph_nodes:
+        return "GraphNode shared-subtree DAGs"
     if np.dtype(options.dtype) != np.float32:
         return "non-float32 compute dtype"
     return None

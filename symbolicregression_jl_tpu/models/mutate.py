@@ -32,6 +32,13 @@ from .simplify import combine_operators, simplify_tree
 __all__ = ["Proposal", "propose_mutation", "accept_mutation", "propose_crossover", "accept_crossover"]
 
 
+def _copy_tree(tree: Node, options) -> Node:
+    """Copy that preserves DAG sharing in graph_nodes mode (Julia's GraphNode
+    copy preserves sharing; plain copy() would silently expand it and inflate
+    complexity past constraints)."""
+    return tree.copy_preserve_sharing() if options.graph_nodes else tree.copy()
+
+
 @dataclasses.dataclass
 class Proposal:
     """One evolution event awaiting batch scoring."""
@@ -56,9 +63,10 @@ def condition_mutation_weights(
     i = {n: k for k, n in enumerate(names)}
     tree = member.tree
 
-    # Node trees don't share subexpressions (GraphNode variant: round 2+).
-    w[i["form_connection"]] = 0.0
-    w[i["break_connection"]] = 0.0
+    if not options.graph_nodes:
+        # plain Node trees don't share subexpressions
+        w[i["form_connection"]] = 0.0
+        w[i["break_connection"]] = 0.0
 
     if tree.degree == 0:
         w[i["mutate_operator"]] = 0.0
@@ -117,6 +125,10 @@ def _apply_mutation(
         return mf.gen_random_tree_fixed_size(
             int(rng.integers(1, tree_size + 1)), ops, nfeatures, rng
         )
+    if kind == "form_connection":
+        return mf.form_random_connection(tree, rng)
+    if kind == "break_connection":
+        return mf.break_random_connection(tree, rng)
     raise ValueError(f"unhandled mutation kind {kind}")
 
 
@@ -132,10 +144,10 @@ def propose_mutation(
     kind = options.mutation_weights.sample(rng, weights)
 
     if kind == "do_nothing":
-        return Proposal(kind, member, member.tree.copy(), needs_score=False)
+        return Proposal(kind, member, _copy_tree(member.tree, options), needs_score=False)
     if kind == "optimize":
         # routed to the batched constant optimizer by the caller
-        return Proposal(kind, member, member.tree.copy(), needs_score=True)
+        return Proposal(kind, member, _copy_tree(member.tree, options), needs_score=True)
 
     # `simplify` preserves semantics and always passes constraints the parent
     # passed; others need the retry loop (reference: <=10 attempts,
@@ -143,7 +155,8 @@ def propose_mutation(
     attempts = 1 if kind == "simplify" else 10
     for _ in range(attempts):
         tree = _apply_mutation(
-            kind, member.tree.copy(), temperature, options, nfeatures, rng
+            kind, _copy_tree(member.tree, options), temperature, options,
+            nfeatures, rng,
         )
         if check_constraints(tree, options, curmaxsize):
             return Proposal(kind, member, tree, needs_score=True)
@@ -165,7 +178,7 @@ def accept_mutation(
 
     def rejected() -> tuple[PopMember, bool]:
         m = PopMember(
-            parent.tree.copy(),
+            _copy_tree(parent.tree, options),
             parent.score,
             parent.loss,
             complexity=parent.get_complexity(options),
@@ -250,7 +263,9 @@ def propose_crossover(
     """Breed until both children pass constraints, <=10 tries
     (reference: crossover_generation, /root/reference/src/Mutate.jl:361-429)."""
     for _ in range(10):
-        c1, c2 = mf.crossover_trees(m1.tree, m2.tree, rng)
+        c1, c2 = mf.crossover_trees(
+            m1.tree, m2.tree, rng, preserve_sharing=options.graph_nodes
+        )
         if check_constraints(c1, options, curmaxsize) and check_constraints(
             c2, options, curmaxsize
         ):
@@ -266,11 +281,11 @@ def accept_crossover(
     if prop.failed or np.isnan(prop.score1) or np.isnan(prop.score2):
         p1, p2 = prop.parent1, prop.parent2
         c1 = PopMember(
-            p1.tree.copy(), p1.score, p1.loss,
+            _copy_tree(p1.tree, options), p1.score, p1.loss,
             complexity=p1.get_complexity(options), parent=p1.ref,
         )
         c2 = PopMember(
-            p2.tree.copy(), p2.score, p2.loss,
+            _copy_tree(p2.tree, options), p2.score, p2.loss,
             complexity=p2.get_complexity(options), parent=p2.ref,
         )
         return c1, c2, False

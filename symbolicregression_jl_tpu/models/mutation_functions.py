@@ -235,14 +235,23 @@ def gen_random_tree_fixed_size(
     return tree
 
 
-def crossover_trees(a: Node, b: Node, rng: np.random.Generator) -> tuple[Node, Node]:
+def crossover_trees(
+    a: Node, b: Node, rng: np.random.Generator, preserve_sharing: bool = False
+) -> tuple[Node, Node]:
     """Swap random subtrees between copies of a and b
     (reference: /root/reference/src/MutationFunctions.jl:271-303)."""
-    a, b = a.copy(), b.copy()
+    if preserve_sharing:
+        a, b = a.copy_preserve_sharing(), b.copy_preserve_sharing()
+    else:
+        a, b = a.copy(), b.copy()
     na, pa, sa = _random_node_and_parent(a, rng)
     nb, pb, sb = _random_node_and_parent(b, rng)
-    na_copy = na.copy()
-    nb_copy = nb.copy()
+    if preserve_sharing:
+        na_copy = na.copy_preserve_sharing()
+        nb_copy = nb.copy_preserve_sharing()
+    else:
+        na_copy = na.copy()
+        nb_copy = nb.copy()
     if sa == "n":
         a = nb_copy
     elif sa == "l":
@@ -256,3 +265,44 @@ def crossover_trees(a: Node, b: Node, rng: np.random.Generator) -> tuple[Node, N
     else:
         pb.r = na_copy
     return a, b
+
+
+# -- GraphNode-only mutations (shared-subtree DAGs) ---------------------------
+
+
+def form_random_connection(tree: Node, rng: np.random.Generator) -> Node:
+    """Make one node's child POINT at another subtree (shared reference),
+    turning the tree into a DAG. No-op for tiny trees or when every candidate
+    pair would form a loop (reference: form_random_connection!,
+    /root/reference/src/MutationFunctions.jl:318-336)."""
+    if tree.count_nodes() < 5:
+        return tree
+    parents = _nodes(tree, lambda t: t.degree >= 1)
+    others = _nodes(tree)
+    for _ in range(10):
+        parent = parents[rng.integers(len(parents))]
+        new_child = others[rng.integers(len(others))]
+        # loop check: parent must not be reachable from new_child
+        if new_child.contains(parent):
+            continue
+        if parent.degree == 1 or rng.random() < 0.5:
+            parent.l = new_child
+        else:
+            parent.r = new_child
+        return tree
+    return tree
+
+
+def break_random_connection(tree: Node, rng: np.random.Generator) -> Node:
+    """Unshare one child by copying it (reference: break_random_connection!,
+    /root/reference/src/MutationFunctions.jl:337-346)."""
+    if tree.degree == 0:
+        return tree
+    parent = random_node(tree, rng, lambda t: t.degree >= 1)
+    if parent is None:
+        return tree
+    if parent.degree == 1 or rng.random() < 0.5:
+        parent.l = parent.l.copy_preserve_sharing()
+    else:
+        parent.r = parent.r.copy_preserve_sharing()
+    return tree

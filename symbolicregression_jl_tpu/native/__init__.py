@@ -1,0 +1,70 @@
+"""Native runtime kernel loader.
+
+Builds the ``srcore`` C extension (srcore.c — the host runtime's tree
+serialization hot path) on first import with the system toolchain and caches
+the shared object next to the source. Everything degrades gracefully to the
+pure-Python implementations when no compiler is available or the build fails:
+``get_srcore()`` returns None in that case and ops/flat.py keeps its Python
+paths. Disable explicitly with SR_NO_NATIVE=1.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_srcore = None
+_tried = False
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, f"srcore{suffix}")
+
+
+def _build() -> str | None:
+    src = os.path.join(_DIR, "srcore.c")
+    out = _so_path()
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "cc")
+    cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", src, "-o", out]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120, cwd=_DIR
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        import warnings
+
+        warnings.warn(
+            f"srcore native build failed (falling back to Python): {proc.stderr[-400:]}"
+        )
+        return None
+    return out
+
+
+def get_srcore():
+    """The srcore module, building it on first call; None when unavailable."""
+    global _srcore, _tried
+    if _tried:
+        return _srcore
+    _tried = True
+    if os.environ.get("SR_NO_NATIVE") == "1":
+        return None
+    so = _build()
+    if so is None:
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("srcore", so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _srcore = mod
+    except Exception:  # noqa: BLE001 — any load failure => Python fallback
+        _srcore = None
+    return _srcore

@@ -1,0 +1,342 @@
+/* srcore — native runtime kernel for host-side tree flattening.
+ *
+ * The framework's device math is XLA/Pallas; this extension is the native
+ * half of the HOST runtime: it walks Python `Node` object graphs (see
+ * tree.py) in postorder and serializes them straight into preallocated numpy
+ * buffers — both the FlatTrees struct-of-arrays layout (ops/flat.py
+ * flatten_trees) and the fused Mosaic kernel's packed slab layout
+ * (ops/flat.py FlatSlab.set_tree). One C pass replaces a Python
+ * dict-and-loop per tree (~10x on the lockstep/async engines' candidate
+ * flattening hot path). Falls back to the pure-Python implementations when
+ * the extension is unavailable (see native/__init__.py).
+ *
+ * Kind codes must match ops/flat.py: PAD=0 CONST=1 VAR=2 UNARY=3 BINARY=4.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define KIND_CONST 1
+#define KIND_VAR 2
+#define KIND_UNARY 3
+#define KIND_BINARY 4
+
+#define MAX_STACK 4096
+#define MAX_NODES 4096
+
+static PyObject *s_degree, *s_is_const, *s_val, *s_feat, *s_op, *s_l, *s_r;
+
+typedef struct {
+    PyObject *node;
+    int expanded;
+} StackEntry;
+
+typedef struct {
+    /* postorder slot map: pointer -> most recent slot (linear probe hash) */
+    void *keys[2 * MAX_NODES];
+    int32_t slots[2 * MAX_NODES];
+} SlotMap;
+
+static inline void slotmap_clear(SlotMap *m, int n) {
+    memset(m->keys, 0, sizeof(void *) * (size_t)(2 * n));
+}
+
+static inline void slotmap_put(SlotMap *m, int cap2, void *k, int32_t v) {
+    size_t h = ((uintptr_t)k >> 4) % (size_t)cap2;
+    while (m->keys[h] != NULL && m->keys[h] != k) h = (h + 1) % (size_t)cap2;
+    m->keys[h] = k;
+    m->slots[h] = v;
+}
+
+static inline int32_t slotmap_get(SlotMap *m, int cap2, void *k) {
+    size_t h = ((uintptr_t)k >> 4) % (size_t)cap2;
+    while (m->keys[h] != NULL) {
+        if (m->keys[h] == k) return m->slots[h];
+        h = (h + 1) % (size_t)cap2;
+    }
+    return -1;
+}
+
+/* Fast attribute access: Node uses __slots__, so GetAttr is a descriptor
+ * lookup; we just use PyObject_GetAttr with interned names. */
+static inline long get_long(PyObject *o, PyObject *name, int *err) {
+    PyObject *a = PyObject_GetAttr(o, name);
+    if (a == NULL) { *err = 1; return 0; }
+    long v = PyLong_AsLong(a);
+    if (v == -1 && PyErr_Occurred()) { Py_DECREF(a); *err = 1; return 0; }
+    Py_DECREF(a);
+    return v;
+}
+
+static inline double get_double(PyObject *o, PyObject *name, int *err) {
+    PyObject *a = PyObject_GetAttr(o, name);
+    if (a == NULL) { *err = 1; return 0.0; }
+    double v = PyFloat_AsDouble(a);
+    if (v == -1.0 && PyErr_Occurred()) { Py_DECREF(a); *err = 1; return 0.0; }
+    Py_DECREF(a);
+    return v;
+}
+
+static inline int get_bool(PyObject *o, PyObject *name, int *err) {
+    PyObject *a = PyObject_GetAttr(o, name);
+    if (a == NULL) { *err = 1; return 0; }
+    int v = PyObject_IsTrue(a);
+    Py_DECREF(a);
+    if (v < 0) { *err = 1; return 0; }
+    return v;
+}
+
+/* Emit one tree in postorder.
+ * mode 0 (FlatTrees): separate kind/op/lhs/rhs/feat int32 rows + float32 val
+ * row (row pointers passed per-array).
+ * mode 1 (slab): one int32 row (code|lhs|rhs|feat|length at strides N) + one
+ * float32 val row; code = 0 const, 1 var, 2+op unary, una_off+op binary.
+ */
+static int emit_tree(PyObject *root, int N,
+                     int32_t *kind, int32_t *op, int32_t *lhs, int32_t *rhs,
+                     int32_t *feat, float *val, int mode, int una_off,
+                     SlotMap *map) {
+    static StackEntry stack[MAX_STACK];
+    int sp = 0;
+    int out = 0;
+    int err = 0;
+
+    slotmap_clear(map, MAX_NODES);
+    stack[sp].node = root;
+    stack[sp].expanded = 0;
+    sp++;
+
+    while (sp > 0) {
+        StackEntry e = stack[--sp];
+        PyObject *n = e.node;
+        long degree = get_long(n, s_degree, &err);
+        if (err) return -1;
+        if (!e.expanded) {
+            if (sp + 3 >= MAX_STACK) {
+                PyErr_SetString(PyExc_ValueError, "tree too deep for srcore");
+                return -1;
+            }
+            stack[sp].node = n;
+            stack[sp].expanded = 1;
+            sp++;
+            if (degree == 2) {
+                PyObject *r = PyObject_GetAttr(n, s_r);
+                if (r == NULL) return -1;
+                Py_DECREF(r); /* borrowed via parent's strong ref */
+                stack[sp].node = r;
+                stack[sp].expanded = 0;
+                sp++;
+            }
+            if (degree >= 1) {
+                /* pushed after r: left pops first -> (l, r, parent) postorder,
+                 * matching tree.py Node.postorder exactly */
+                PyObject *l = PyObject_GetAttr(n, s_l);
+                if (l == NULL) return -1;
+                Py_DECREF(l);
+                stack[sp].node = l;
+                stack[sp].expanded = 0;
+                sp++;
+            }
+            continue;
+        }
+        if (out >= N) {
+            PyErr_Format(PyExc_ValueError,
+                         "tree exceeds max_nodes=%d during native flatten", N);
+            return -1;
+        }
+        slotmap_put(map, 2 * MAX_NODES, (void *)n, out);
+        if (degree == 0) {
+            int is_c = get_bool(n, s_is_const, &err);
+            if (err) return -1;
+            if (is_c) {
+                if (mode == 0) kind[out] = KIND_CONST; else kind[out] = 0;
+                val[out] = (float)get_double(n, s_val, &err);
+                if (err) return -1;
+            } else {
+                if (mode == 0) kind[out] = KIND_VAR; else kind[out] = 1;
+                long f = get_long(n, s_feat, &err);
+                if (err) return -1;
+                feat[out] = (int32_t)f;
+            }
+        } else {
+            long opidx = get_long(n, s_op, &err);
+            if (err) return -1;
+            PyObject *l = PyObject_GetAttr(n, s_l);
+            if (l == NULL) return -1;
+            int32_t ls = slotmap_get(map, 2 * MAX_NODES, (void *)l);
+            Py_DECREF(l);
+            if (ls < 0) {
+                PyErr_SetString(PyExc_RuntimeError, "postorder invariant broken");
+                return -1;
+            }
+            lhs[out] = ls;
+            if (degree == 1) {
+                if (mode == 0) { kind[out] = KIND_UNARY; op[out] = (int32_t)opidx; }
+                else kind[out] = 2 + (int32_t)opidx;
+            } else {
+                PyObject *r = PyObject_GetAttr(n, s_r);
+                if (r == NULL) return -1;
+                int32_t rs = slotmap_get(map, 2 * MAX_NODES, (void *)r);
+                Py_DECREF(r);
+                if (rs < 0) {
+                    PyErr_SetString(PyExc_RuntimeError, "postorder invariant broken");
+                    return -1;
+                }
+                rhs[out] = rs;
+                if (mode == 0) { kind[out] = KIND_BINARY; op[out] = (int32_t)opidx; }
+                else kind[out] = una_off + (int32_t)opidx;
+            }
+        }
+        out++;
+    }
+    return out;
+}
+
+static int get_buf(PyObject *obj, Py_buffer *b, int itemsize) {
+    if (PyObject_GetBuffer(obj, b, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) != 0)
+        return -1;
+    if (b->itemsize != itemsize) {
+        PyBuffer_Release(b);
+        PyErr_SetString(PyExc_TypeError, "buffer itemsize mismatch");
+        return -1;
+    }
+    return 0;
+}
+
+/* flatten_batch(trees, kind, op, lhs, rhs, feat, val, length)
+ * arrays: int32 [P, N] x5, float32 [P, N], int32 [P]; rows assumed zeroed
+ * or fully overwritten (we zero the live prefix ourselves). */
+static PyObject *flatten_batch(PyObject *self, PyObject *args) {
+    PyObject *trees, *a_kind, *a_op, *a_lhs, *a_rhs, *a_feat, *a_val, *a_len;
+    if (!PyArg_ParseTuple(args, "OOOOOOOO", &trees, &a_kind, &a_op, &a_lhs,
+                          &a_rhs, &a_feat, &a_val, &a_len))
+        return NULL;
+    Py_buffer kind, op, lhs, rhs, feat, val, len;
+    if (get_buf(a_kind, &kind, 4)) return NULL;
+    if (get_buf(a_op, &op, 4)) { PyBuffer_Release(&kind); return NULL; }
+    if (get_buf(a_lhs, &lhs, 4)) goto fail2;
+    if (get_buf(a_rhs, &rhs, 4)) goto fail3;
+    if (get_buf(a_feat, &feat, 4)) goto fail4;
+    if (get_buf(a_val, &val, 4)) goto fail5;
+    if (get_buf(a_len, &len, 4)) goto fail6;
+
+    {
+        Py_ssize_t P = PySequence_Length(trees);
+        int N = (int)(kind.shape ? kind.shape[1] : 0);
+        if (N > MAX_NODES || P > kind.shape[0]) {
+            PyErr_Format(PyExc_ValueError,
+                         "srcore capacity exceeded (N=%d > %d or P out of range)",
+                         N, MAX_NODES);
+            goto fail7;
+        }
+        SlotMap *map = PyMem_Malloc(sizeof(SlotMap));
+        if (map == NULL) { PyErr_NoMemory(); goto fail7; }
+        for (Py_ssize_t p = 0; p < P; p++) {
+            PyObject *t = PySequence_GetItem(trees, p);
+            if (t == NULL) { PyMem_Free(map); goto fail7; }
+            int32_t *krow = (int32_t *)kind.buf + p * N;
+            int32_t *orow = (int32_t *)op.buf + p * N;
+            int32_t *lrow = (int32_t *)lhs.buf + p * N;
+            int32_t *rrow = (int32_t *)rhs.buf + p * N;
+            int32_t *frow = (int32_t *)feat.buf + p * N;
+            float *vrow = (float *)val.buf + p * N;
+            memset(krow, 0, sizeof(int32_t) * (size_t)N);
+            memset(orow, 0, sizeof(int32_t) * (size_t)N);
+            memset(lrow, 0, sizeof(int32_t) * (size_t)N);
+            memset(rrow, 0, sizeof(int32_t) * (size_t)N);
+            memset(frow, 0, sizeof(int32_t) * (size_t)N);
+            memset(vrow, 0, sizeof(float) * (size_t)N);
+            int n = emit_tree(t, N, krow, orow, lrow, rrow, frow, vrow, 0, 0, map);
+            Py_DECREF(t);
+            if (n < 0) { PyMem_Free(map); goto fail7; }
+            ((int32_t *)len.buf)[p] = n;
+        }
+        PyMem_Free(map);
+    }
+    PyBuffer_Release(&kind); PyBuffer_Release(&op); PyBuffer_Release(&lhs);
+    PyBuffer_Release(&rhs); PyBuffer_Release(&feat); PyBuffer_Release(&val);
+    PyBuffer_Release(&len);
+    Py_RETURN_NONE;
+
+fail7: PyBuffer_Release(&len);
+fail6: PyBuffer_Release(&val);
+fail5: PyBuffer_Release(&feat);
+fail4: PyBuffer_Release(&rhs);
+fail3: PyBuffer_Release(&lhs);
+fail2: PyBuffer_Release(&op); PyBuffer_Release(&kind);
+    return NULL;
+}
+
+/* slab_fill(trees, ints, vals, start, n_slots, una_off)
+ * ints: int32 [cap, L] packed (code|lhs|rhs|feat at strides N, length at 4N);
+ * vals: float32 [cap, Lv]. */
+static PyObject *slab_fill(PyObject *self, PyObject *args) {
+    PyObject *trees, *a_ints, *a_vals;
+    int start, N, una_off;
+    if (!PyArg_ParseTuple(args, "OOOiii", &trees, &a_ints, &a_vals, &start, &N,
+                          &una_off))
+        return NULL;
+    Py_buffer ints, vals;
+    if (get_buf(a_ints, &ints, 4)) return NULL;
+    if (get_buf(a_vals, &vals, 4)) { PyBuffer_Release(&ints); return NULL; }
+
+    {
+        Py_ssize_t P = PySequence_Length(trees);
+        Py_ssize_t L = ints.shape[1];
+        Py_ssize_t Lv = vals.shape[1];
+        if (N > MAX_NODES || start < 0 || start + P > ints.shape[0] ||
+            start + P > vals.shape[0] || 4 * (Py_ssize_t)N + 1 > L ||
+            (Py_ssize_t)N > Lv) {
+            PyErr_SetString(PyExc_ValueError,
+                            "srcore slab_fill bounds check failed");
+            goto fail;
+        }
+        SlotMap *map = PyMem_Malloc(sizeof(SlotMap));
+        if (map == NULL) { PyErr_NoMemory(); goto fail; }
+        for (Py_ssize_t p = 0; p < P; p++) {
+            PyObject *t = PySequence_GetItem(trees, p);
+            if (t == NULL) { PyMem_Free(map); goto fail; }
+            int32_t *row = (int32_t *)ints.buf + (start + p) * L;
+            float *vrow = (float *)vals.buf + (start + p) * Lv;
+            memset(row, 0, sizeof(int32_t) * (size_t)(4 * N + 1));
+            memset(vrow, 0, sizeof(float) * (size_t)N);
+            int n = emit_tree(t, N, row, NULL, row + N, row + 2 * N, row + 3 * N,
+                              vrow, 1, una_off, map);
+            Py_DECREF(t);
+            if (n < 0) { PyMem_Free(map); goto fail; }
+            row[4 * N] = n;
+        }
+        PyMem_Free(map);
+    }
+    PyBuffer_Release(&ints); PyBuffer_Release(&vals);
+    Py_RETURN_NONE;
+
+fail:
+    PyBuffer_Release(&ints); PyBuffer_Release(&vals);
+    return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"flatten_batch", flatten_batch, METH_VARARGS,
+     "Flatten a list of Node trees into FlatTrees-layout numpy buffers."},
+    {"slab_fill", slab_fill, METH_VARARGS,
+     "Flatten a list of Node trees into the packed slab layout."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "srcore", "native tree-flattening kernel", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit_srcore(void) {
+    s_degree = PyUnicode_InternFromString("degree");
+    s_is_const = PyUnicode_InternFromString("is_const");
+    s_val = PyUnicode_InternFromString("val");
+    s_feat = PyUnicode_InternFromString("feat");
+    s_op = PyUnicode_InternFromString("op");
+    s_l = PyUnicode_InternFromString("l");
+    s_r = PyUnicode_InternFromString("r");
+    return PyModule_Create(&moduledef);
+}

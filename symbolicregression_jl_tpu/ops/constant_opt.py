@@ -323,6 +323,36 @@ def optimize_constants_batched(
         return [], np.zeros((0,)), np.zeros((0,), dtype=bool)
     if options.loss_function is not None:
         return _optimize_constants_custom_objective(trees, scorer, options, rng)
+    if options.graph_nodes and any(
+        t.count_unique_nodes() != t.count_nodes() for t in trees
+    ):
+        # Shared constants would expand into multiple independent device
+        # parameters and the writeback would unshare the DAG; optimize only
+        # the sharing-free trees and pass the rest through unchanged.
+        shared = [t.count_unique_nodes() != t.count_nodes() for t in trees]
+        plain = [t for t, s in zip(trees, shared) if not s]
+        if plain:
+            p_trees, p_losses, p_improved = optimize_constants_batched(
+                plain, scorer, options, rng, idx=idx
+            )
+        else:
+            p_trees, p_losses, p_improved = [], np.zeros(0), np.zeros(0, bool)
+        shared_trees = [t for t, s in zip(trees, shared) if s]
+        shared_losses = scorer.loss_many(shared_trees, idx=idx) if shared_trees else []
+        out_t, out_l, out_i = [], [], []
+        pi = si = 0
+        for s in shared:
+            if s:
+                out_t.append(shared_trees[si])
+                out_l.append(float(shared_losses[si]))
+                out_i.append(False)
+                si += 1
+            else:
+                out_t.append(p_trees[pi])
+                out_l.append(float(p_losses[pi]))
+                out_i.append(bool(p_improved[pi]))
+                pi += 1
+        return out_t, np.asarray(out_l), np.asarray(out_i)
 
     n_real = len(trees)
     # pad the batch to a power-of-two bucket so the (large) BFGS program

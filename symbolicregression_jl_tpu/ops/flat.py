@@ -90,7 +90,9 @@ def flatten_trees(
 ) -> FlatTrees:
     """Flatten host trees into one padded postorder batch (numpy; the caller
     device_puts / donates). Trees longer than max_nodes are a bug upstream —
-    constraint checking caps sizes before anything is flattened."""
+    constraint checking caps sizes before anything is flattened.
+
+    Uses the srcore native kernel when available (~10x; see native/)."""
     P = len(trees)
     kind = np.zeros((P, max_nodes), dtype=np.int32)
     op = np.zeros((P, max_nodes), dtype=np.int32)
@@ -99,6 +101,14 @@ def flatten_trees(
     feat = np.zeros((P, max_nodes), dtype=np.int32)
     val = np.zeros((P, max_nodes), dtype=dtype)
     length = np.zeros((P,), dtype=np.int32)
+
+    if P and np.dtype(dtype) == np.float32 and max_nodes <= 4096:
+        from ..native import get_srcore
+
+        core = get_srcore()
+        if core is not None:
+            core.flatten_batch(trees, kind, op, lhs, rhs, feat, val, length)
+            return FlatTrees(kind, op, lhs, rhs, feat, val, length)
 
     for p, tree in enumerate(trees):
         post = tree.postorder()
@@ -187,6 +197,20 @@ class FlatSlab:
         row[4 * N] = len(post)
 
     def set_trees(self, trees: list[Node], start: int = 0) -> None:
+        if start < 0 or start + len(trees) > self.capacity:
+            raise IndexError(
+                f"slab write [{start}, {start + len(trees)}) exceeds "
+                f"capacity {self.capacity}"
+            )
+        if trees and self.vals.dtype == np.float32 and self.n_slots <= 4096:
+            from ..native import get_srcore
+
+            core = get_srcore()
+            if core is not None:
+                core.slab_fill(
+                    trees, self.ints, self.vals, start, self.n_slots, self._bin_off
+                )
+                return
         for k, t in enumerate(trees):
             self.set_tree(start + k, t)
 

@@ -126,6 +126,11 @@ class Options:
     fraction_replaced_hof: float = 0.035
     should_simplify: bool | None = None
     should_optimize_constants: bool = True
+    # GraphNode mode: expressions may share subtrees (DAGs); enables the
+    # form_connection / break_connection mutations and switches complexity to
+    # unique-node counting (reference: node_type=GraphNode, experimental,
+    # /root/reference/src/SymbolicRegression.jl:616-618)
+    graph_nodes: bool = False
 
     # -- constant optimizer --------------------------------------------------
     optimizer_algorithm: str = "BFGS"
@@ -178,8 +183,9 @@ class Options:
             self.maxdepth = self.maxsize
         if self.should_simplify is None:
             # Reference disables auto-simplify when a full custom objective is
-            # used (the objective may depend on exact tree shape).
-            self.should_simplify = self.loss_function is None
+            # used (the objective may depend on exact tree shape); algebraic
+            # rewriting would also silently break GraphNode sharing.
+            self.should_simplify = self.loss_function is None and not self.graph_nodes
         if self.deterministic and self.seed is None:
             self.seed = 0
         if self.scheduler not in ("lockstep", "device", "async"):

@@ -48,6 +48,48 @@ class Node:
             return Node(1, op=self.op, l=self.l.copy())
         return Node(2, op=self.op, l=self.l.copy(), r=self.r.copy())
 
+    def copy_preserve_sharing(self, memo: dict | None = None) -> "Node":
+        """Copy that keeps shared-subtree topology (GraphNode semantics —
+        the reference's GraphNode copy, used when preserve_sharing is on)."""
+        if memo is None:
+            memo = {}
+        hit = memo.get(id(self))
+        if hit is not None:
+            return hit
+        if self.degree == 0:
+            new = Node(0, self.is_const, self.val, self.feat)
+        elif self.degree == 1:
+            new = Node(1, op=self.op, l=self.l.copy_preserve_sharing(memo))
+        else:
+            new = Node(
+                2,
+                op=self.op,
+                l=self.l.copy_preserve_sharing(memo),
+                r=self.r.copy_preserve_sharing(memo),
+            )
+        memo[id(self)] = new
+        return new
+
+    def count_unique_nodes(self) -> int:
+        """Node count with shared subtrees counted ONCE (GraphNode complexity,
+        reference: shared-node-aware tree_mapreduce in Complexity.jl:17-50)."""
+        seen: set[int] = set()
+        stack = [self]
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            if n.degree >= 1:
+                stack.append(n.l)
+            if n.degree == 2:
+                stack.append(n.r)
+        return len(seen)
+
+    def contains(self, other: "Node") -> bool:
+        """True iff `other` (by identity) is reachable from self."""
+        return any(n is other for n in self)
+
     # -- traversal -----------------------------------------------------------
 
     def __iter__(self) -> Iterator["Node"]:

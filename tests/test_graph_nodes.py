@@ -1,0 +1,130 @@
+"""GraphNode shared-subtree DAG mode (reference: node_type=GraphNode,
+test_graph_nodes.jl)."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.complexity import compute_complexity
+from symbolicregression_jl_tpu.models.mutation_functions import (
+    break_random_connection,
+    form_random_connection,
+)
+from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+OPTS = Options(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    graph_nodes=True,
+    save_to_file=False,
+)
+ADD, SUB, MUL = 0, 1, 2
+COS = 0
+
+
+def _shared_tree():
+    """cos(x1) used twice via a genuinely shared node."""
+    shared = unary(COS, feature(0))
+    return binary(ADD, shared, binary(MUL, shared, constant(2.0))), shared
+
+
+class TestSharing:
+    def test_unique_vs_expanded_count(self):
+        t, shared = _shared_tree()
+        assert t.count_nodes() == 7  # expanded (cos(x1) duplicated)
+        assert t.count_unique_nodes() == 5  # shared once
+        assert compute_complexity(t, OPTS) == 5
+
+    def test_copy_preserve_sharing(self):
+        t, _ = _shared_tree()
+        c = t.copy_preserve_sharing()
+        assert c.l is c.r.l  # sharing topology preserved
+        assert c.count_unique_nodes() == 5
+        plain = t.copy()
+        assert plain.l is not plain.r.l  # deep copy expands
+
+    def test_eval_matches_expanded(self):
+        t, _ = _shared_tree()
+        X = np.random.default_rng(0).normal(size=(1, 40))
+        got = t.eval_np(X, OPTS.operators)
+        want = np.cos(X[0]) + np.cos(X[0]) * 2.0
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_flatten_expands_sharing(self):
+        from symbolicregression_jl_tpu.ops.flat import flatten_trees, unflatten_tree
+
+        t, _ = _shared_tree()
+        flat = flatten_trees([t], OPTS.max_nodes)
+        assert int(flat.length[0]) == 7
+        back = unflatten_tree(flat, 0)
+        X = np.random.default_rng(1).normal(size=(1, 20))
+        np.testing.assert_allclose(
+            back.eval_np(X, OPTS.operators), t.eval_np(X, OPTS.operators), rtol=1e-6
+        )
+
+
+class TestConnectionMutations:
+    def test_form_connection_creates_sharing(self):
+        rng = np.random.default_rng(0)
+        made_dag = False
+        for seed in range(30):
+            t = binary(
+                ADD,
+                unary(COS, binary(MUL, feature(0), constant(1.0))),
+                binary(MUL, feature(0), constant(3.0)),
+            )
+            out = form_random_connection(t, np.random.default_rng(seed))
+            if out.count_unique_nodes() < out.count_nodes():
+                made_dag = True
+                break
+        assert made_dag
+
+    def test_form_connection_never_loops(self):
+        for seed in range(50):
+            t = binary(
+                ADD,
+                unary(COS, binary(MUL, feature(0), constant(1.0))),
+                binary(MUL, feature(0), constant(3.0)),
+            )
+            out = form_random_connection(t, np.random.default_rng(seed))
+            # traversal must terminate (no cycles): count_nodes would hang on
+            # a loop; cap via expanded count sanity
+            assert out.count_nodes() < 200
+
+    def test_break_connection_unshares(self):
+        t, shared = _shared_tree()
+        rng = np.random.default_rng(3)
+        for _ in range(20):
+            break_random_connection(t, rng)
+        # eventually all sharing is broken
+        assert t.count_unique_nodes() == t.count_nodes()
+
+
+def test_graph_search_end_to_end():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 80)).astype(np.float32)
+    y = (np.cos(X[0]) + 2 * np.cos(X[0]) * X[1]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        graph_nodes=True,
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+    )
+    res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+
+
+def test_device_mode_rejects_graph_nodes():
+    opts = Options(
+        binary_operators=["+"], graph_nodes=True, scheduler="device",
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1, 30)).astype(np.float32)
+    with pytest.raises(ValueError, match="GraphNode"):
+        equation_search(X, X[0], options=opts, niterations=1, verbosity=0)
