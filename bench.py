@@ -19,6 +19,15 @@ async pipelined before) — measured in round 2 and the reason the search engine
 keeps evolution state on device. The secondary metric reports the
 poisoned-regime (sync) throughput for honesty.
 
+Transfer-pattern notes (measured round 2, idle host): the simple fresh
+full-array upload per sweep (~10.5MB) sustains ~15-24ms/sweep. Two attempted
+optimizations are SLOWER on this backend and were removed: (a) compact int16
+upload with in-graph expand (device-side astype+pad breaks transfer/compute
+overlap: ~105ms/sweep), (b) device-resident slab with dynamic_update_slice of
+dirty rows (small chained H2Ds serialize with the dispatch queue:
+~147ms/sweep). Results are also sensitive to host CPU load — concurrent
+processes starve the tunnel client threads (~8x degradation under pytest).
+
 vs_baseline: the reference publishes no absolute numbers (BASELINE.md), so the
 denominator is a documented engineering estimate of the reference's
 :multithreading full-data eval throughput at 10k rows on a 16-core host:

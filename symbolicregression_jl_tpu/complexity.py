@@ -13,15 +13,7 @@ __all__ = ["compute_complexity", "past_complexity_limit"]
 
 
 def _iter_nodes(tree: Node, unique: bool):
-    if not unique:
-        yield from tree
-        return
-    seen: set[int] = set()
-    for n in tree:
-        if id(n) in seen:
-            continue
-        seen.add(id(n))
-        yield n
+    return tree.iter_unique() if unique else iter(tree)
 
 
 def compute_complexity(tree: Node, options) -> int:

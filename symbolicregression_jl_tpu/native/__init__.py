@@ -32,7 +32,11 @@ def _build() -> str | None:
         return out
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "cc")
-    cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", src, "-o", out]
+    # compile to a per-process temp file, then atomically promote: concurrent
+    # builders (pytest workers, multi-host SPMD launches on shared FS) must
+    # not interleave writes into the cached .so
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", src, "-o", tmp]
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120, cwd=_DIR
@@ -46,6 +50,7 @@ def _build() -> str | None:
             f"srcore native build failed (falling back to Python): {proc.stderr[-400:]}"
         )
         return None
+    os.replace(tmp, out)
     return out
 
 
@@ -65,6 +70,9 @@ def get_srcore():
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         _srcore = mod
-    except Exception:  # noqa: BLE001 — any load failure => Python fallback
+    except Exception as e:  # noqa: BLE001 — any load failure => Python fallback
+        import warnings
+
+        warnings.warn(f"srcore load failed (Python fallback): {type(e).__name__}: {e}")
         _srcore = None
     return _srcore

@@ -323,13 +323,14 @@ def optimize_constants_batched(
         return [], np.zeros((0,)), np.zeros((0,), dtype=bool)
     if options.loss_function is not None:
         return _optimize_constants_custom_objective(trees, scorer, options, rng)
-    if options.graph_nodes and any(
-        t.count_unique_nodes() != t.count_nodes() for t in trees
-    ):
+    if options.graph_nodes:
+        shared = [t.count_unique_nodes() != t.count_nodes() for t in trees]
+    else:
+        shared = None
+    if shared is not None and any(shared):
         # Shared constants would expand into multiple independent device
         # parameters and the writeback would unshare the DAG; optimize only
         # the sharing-free trees and pass the rest through unchanged.
-        shared = [t.count_unique_nodes() != t.count_nodes() for t in trees]
         plain = [t for t, s in zip(trees, shared) if not s]
         if plain:
             p_trees, p_losses, p_improved = optimize_constants_batched(

@@ -514,24 +514,36 @@ def _apply_mutation(
 
 
 def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
-    """One regularized-evolution event per island: tournament -> mutate or
-    crossover -> score -> Metropolis accept -> ALWAYS replace oldest (the
-    reference replaces the oldest member with the baby even on rejection —
-    the baby is then a copy of the parent;
-    /root/reference/src/RegularizedEvolution.jl:33-105)."""
+    """One full evolve pass: ALL of a cycle's events for ALL islands in one
+    batched step. The reference runs a pass's events sequentially
+    (/root/reference/src/RegularizedEvolution.jl:31-33); batching them against
+    one population snapshot is the same staleness the host lockstep engine
+    documents (~E concurrent events) and buys an E-fold cut in per-iteration
+    dispatch count. Tournament -> mutate or crossover -> score -> Metropolis
+    accept -> ALWAYS replace: event lane e replaces the (2e)-th oldest member
+    (the reference replaces the oldest even on rejection — the baby is then a
+    parent copy; :33-105) and a crossover's second child the (2e+1)-th."""
     I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    E = min(cfg.events_per_cycle, P)  # host parity: ceil(P/tournament_n) <= P
+    L = I * E  # event lanes
+    # crossover needs a second replacement slot per lane; with 2E > P the
+    # stride-2 slot scheme cannot stay collision-free, so tiny populations run
+    # mutation-only (documented deviation; the reference would error earlier)
+    can_pair = 2 * E <= P
     key, k_t1, k_t2, k_mut, k_kind, k_flip, k_xo, k_acc = jax.random.split(
         state.key, 8
     )
 
+    score_r = jnp.repeat(state.score, E, axis=0)  # [L, P], lane l -> island l//E
+    length_r = jnp.repeat(state.length, E, axis=0)
     win1 = jax.vmap(lambda k, s, l: _tournament(k, s, l, state.freq, cfg))(
-        jax.random.split(k_t1, I), state.score, state.length
+        jax.random.split(k_t1, L), score_r, length_r
     )
     win2 = jax.vmap(lambda k, s, l: _tournament(k, s, l, state.freq, cfg))(
-        jax.random.split(k_t2, I), state.score, state.length
+        jax.random.split(k_t2, L), score_r, length_r
     )
 
-    isl = jnp.arange(I)
+    isl = jnp.repeat(jnp.arange(I), E)  # island of each lane
     parent1 = jax.vmap(lambda i, p: _member_tree(state, i, p))(isl, win1)
     parent2 = jax.vmap(lambda i, p: _member_tree(state, i, p))(isl, win2)
     pscore1 = state.score[isl, win1]
@@ -540,9 +552,9 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     ploss2 = state.loss[isl, win2]
 
     do_xover = (
-        jax.random.uniform(k_flip, (I,)) < cfg.crossover_probability
-        if cfg.crossover_probability > 0
-        else jnp.zeros((I,), bool)
+        jax.random.uniform(k_flip, (L,)) < cfg.crossover_probability
+        if cfg.crossover_probability > 0 and can_pair
+        else jnp.zeros((L,), bool)
     )
 
     # mutation path
@@ -552,19 +564,19 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
         w = w.at[M_NOTHING].add(jnp.where(jnp.sum(w) <= 0, 1.0, 0.0))
         return jax.random.choice(k, 8, p=w / jnp.sum(w))
 
-    mut_kinds = jax.vmap(choose_kind)(jax.random.split(k_kind, I), parent1)
+    mut_kinds = jax.vmap(choose_kind)(jax.random.split(k_kind, L), parent1)
     mutated = jax.vmap(
         lambda k, t, m: _apply_mutation(k, t, m, cfg, curmaxsize, temperature)
-    )(jax.random.split(k_mut, I), parent1, mut_kinds)
+    )(jax.random.split(k_mut, L), parent1, mut_kinds)
 
     # crossover path (children pair)
     xo1, xo2 = jax.vmap(lambda k, a, b: _crossover(k, a, b, cfg))(
-        jax.random.split(k_xo, I), parent1, parent2
+        jax.random.split(k_xo, L), parent1, parent2
     )
 
     def pick(a, b, flag):
         return jax.tree_util.tree_map(
-            lambda x, y: jnp.where(flag.reshape((I,) + (1,) * (x.ndim - 1)), x, y),
+            lambda x, y: jnp.where(flag.reshape((L,) + (1,) * (x.ndim - 1)), x, y),
             a,
             b,
         )
@@ -572,15 +584,15 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     cand1 = pick(xo1, mutated, do_xover)
     # cand2 is only meaningful where do_xover; stub the rest down to a 1-node
     # leaf so the kernel's length-bounded slot loop does ~no work for them
-    # (they are still scored — static [2I] batch — but at leaf cost)
+    # (they are still scored — static [2L] batch — but at leaf cost)
     leaf_stub = Tree(
-        kind=jnp.zeros((I, N), jnp.int32).at[:, 0].set(KIND_CONST),
-        op=jnp.zeros((I, N), jnp.int32),
-        lhs=jnp.zeros((I, N), jnp.int32),
-        rhs=jnp.zeros((I, N), jnp.int32),
-        feat=jnp.zeros((I, N), jnp.int32),
-        val=jnp.zeros((I, N), jnp.float32),
-        length=jnp.ones((I,), jnp.int32),
+        kind=jnp.zeros((L, N), jnp.int32).at[:, 0].set(KIND_CONST),
+        op=jnp.zeros((L, N), jnp.int32),
+        lhs=jnp.zeros((L, N), jnp.int32),
+        rhs=jnp.zeros((L, N), jnp.int32),
+        feat=jnp.zeros((L, N), jnp.int32),
+        val=jnp.zeros((L, N), jnp.float32),
+        length=jnp.ones((L,), jnp.int32),
     )
     cand2 = pick(xo2, leaf_stub, do_xover)
 
@@ -595,12 +607,12 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     cand1, ok1 = validate(cand1, parent1)
     cand2, ok2 = validate(cand2, parent2)
 
-    # --- score both candidate sets in ONE batched call: [2I] trees ----------
+    # --- score both candidate sets in ONE batched call: [2L] trees ----------
     batch = jax.tree_util.tree_map(
         lambda a, b: jnp.concatenate([a, b], axis=0), cand1, cand2
     )
-    losses = score_fn(batch)  # [2I]
-    loss1, loss2 = losses[:I], losses[I:]
+    losses = score_fn(batch)  # [2L]
+    loss1, loss2 = losses[:L], losses[L:]
     score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg)
     score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg)
 
@@ -609,7 +621,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     fnorm = state.freq / jnp.maximum(jnp.sum(state.freq), 1e-30)
     sz_old = jnp.clip(state.length[isl, win1], 0, cfg.maxsize)
     sz_new = jnp.clip(cand1.length, 0, cfg.maxsize)
-    prob = jnp.ones((I,), jnp.float32)
+    prob = jnp.ones((L,), jnp.float32)
     if cfg.annealing:
         delta = score1 - pscore1
         # temperature hits exactly 0 on the final cycle: IEEE inf/0 semantics
@@ -619,7 +631,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
         old_f = jnp.maximum(fnorm[sz_old], 1e-6)
         new_f = jnp.maximum(fnorm[sz_new], 1e-6)
         prob = prob * (old_f / new_f)
-    u = jax.random.uniform(k_acc, (I,))
+    u = jax.random.uniform(k_acc, (L,))
     accept1 = ~(prob < u) & jnp.isfinite(loss1) & ok1
     accept1 = jnp.where(do_xover, jnp.isfinite(loss1) & ok1, accept1)
     accept2 = do_xover & jnp.isfinite(loss2) & ok2
@@ -632,12 +644,21 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     bloss2 = jnp.where(accept2, loss2, ploss2)
     bscore2 = jnp.where(accept2, score2, pscore2)
 
-    # --- replace oldest (always), crossover replaces the two oldest ---------
+    # --- replacement: lane e of island i replaces the (2e)-th oldest member,
+    # its crossover child the (2e+1)-th — distinct slots, so the whole pass
+    # scatters without collisions ---------------------------------------------
+    order = jnp.argsort(state.birth, axis=1)  # [I, P], oldest first
+    stride = 2 if can_pair else 1
+    lane_e = jnp.arange(L) % E  # e of each lane (lanes are i*E+e)
+    idx1 = jnp.clip(stride * lane_e, 0, P - 1)
+    idx2 = jnp.clip(stride * lane_e + 1, 0, P - 1)  # only read when can_pair
+    slot1 = order[isl, idx1]
+    slot2 = order[isl, idx2]
+
     def insert(st: EvoState, member_idx, tree_b, loss_b, score_b, mask):
-        """Overwrite member_idx of each island with tree_b where mask (mask
-        only gates crossover's second slot; first slot always inserts)."""
+        """Scatter [L]-lane babies into per-island member slots where mask."""
         sel = lambda cur, new: cur.at[isl, member_idx].set(
-            jnp.where(mask.reshape((I,) + (1,) * (new.ndim - 1)), new, cur[isl, member_idx])
+            jnp.where(mask.reshape((L,) + (1,) * (new.ndim - 1)), new, cur[isl, member_idx])
         )
         return st._replace(
             kind=sel(st.kind, tree_b.kind),
@@ -660,12 +681,8 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
             ),
         )
 
-    oldest1 = jnp.argmin(state.birth, axis=1)
-    st = insert(state, oldest1, baby1, bloss1, bscore1, jnp.ones((I,), bool))
-    oldest2 = jnp.argmin(
-        st.birth.at[isl, oldest1].set(jnp.iinfo(jnp.int32).max), axis=1
-    )
-    st = insert(st, oldest2, baby2, bloss2, bscore2, do_xover)
+    st = insert(state, slot1, baby1, bloss1, bscore1, jnp.ones((L,), bool))
+    st = insert(st, slot2, baby2, bloss2, bscore2, do_xover)
 
     # --- frequency histogram (accepted inserts) ------------------------------
     freq = st.freq.at[jnp.clip(baby1.length, 0, cfg.maxsize)].add(
@@ -699,7 +716,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     bs_len = jnp.where(better, batch.length[best_idx], st.bs_tree[6])
     bs_exists = st.bs_exists | better
 
-    n_scored = I + jnp.sum(do_xover)
+    n_scored = L + jnp.sum(do_xover)
     return st._replace(
         freq=freq,
         bs_loss=bs_loss,
@@ -727,8 +744,7 @@ def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
     NOTE every argument is a device array or static — post-first-readback this
     backend charges ~100ms fixed per host-to-device transfer, so even scalars
     (curmaxsize) are computed ON DEVICE from state.iteration."""
-    E = cfg.events_per_cycle
-    total = cfg.ncycles * E
+    total = cfg.ncycles  # one batched _event per cycle (all events at once)
 
     # warmup-maxsize schedule (get_cur_maxsize,
     # /root/reference/src/SearchUtils.jl:458-470), on device
@@ -741,8 +757,7 @@ def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
     else:
         curmaxsize = jnp.asarray(cfg.maxsize, jnp.int32)
 
-    def body(i, st):
-        cycle = i // E
+    def body(cycle, st):
         # linspace(1, 0, ncycles): the final cycle runs at exactly T=0
         # (host parity: models/single_iteration.py np.linspace(1.0, 0.0, n))
         frac = cycle.astype(jnp.float32) / max(cfg.ncycles - 1, 1)

@@ -1,0 +1,98 @@
+"""Multi-host orchestration over DCN via jax.distributed.
+
+The reference scales to multiple nodes with Distributed.jl — a head process
+doing addprocs + code shipping + per-worker pipeline tests
+(/root/reference/src/Configure.jl:309-343,
+/root/reference/src/SymbolicRegression.jl:297-320). The TPU-native story is
+SPMD: every host launches the SAME program, ``initialize()`` wires the hosts
+into one JAX runtime (device mesh spanning all chips over ICI within a pod
+and DCN across pods), and the existing mesh/sharding layer (mesh.py,
+sharding.py) plus the device-resident engine's island axis do the rest — no
+code movement, no worker bootstrap.
+
+Topology roles:
+  - islands (the 'pop' mesh axis / the device engine's I axis) shard across
+    processes — each host evolves its own islands, exactly like the
+    reference's one-population-per-worker assignment;
+  - migration between hosts' islands becomes a collective (all_gather of the
+    compact migration pool — flattened best members — followed by local
+    replacement), riding DCN once per iteration;
+  - dataset rows shard over the 'rows' axis for the psum loss reduction
+    (sharding.py), which stays within a pod's ICI.
+
+Single-host (including the 1-chip bench host and the virtual-CPU test mesh)
+is the degenerate case: ``initialize()`` is a no-op and every helper below
+works unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = [
+    "initialize",
+    "is_distributed",
+    "process_island_slice",
+    "all_gather_migration_pool",
+]
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host JAX runtime (jax.distributed.initialize). Reads the
+    standard env vars when args are omitted; silently a no-op for single-host
+    runs so the same script works everywhere."""
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "SR_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None and num_processes is None:
+        return  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def is_distributed() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_island_slice(n_islands: int) -> tuple[int, int]:
+    """[start, stop) of the island axis owned by this process — the
+    multi-host analogue of the reference's WorkerAssignments
+    (/root/reference/src/SearchUtils.jl:62-86), but static: islands are
+    evenly striped across processes."""
+    import jax
+
+    p = jax.process_index()
+    n = jax.process_count()
+    per = -(-n_islands // n)
+    start = min(p * per, n_islands)
+    stop = min(start + per, n_islands)
+    return start, stop
+
+
+def all_gather_migration_pool(local_pool_arrays):
+    """Gather each host's compact migration pool (flattened best members:
+    FlatTrees-style arrays + losses) into the global pool on every host.
+
+    The only cross-host traffic of the island model — a few KB of flattened
+    trees once per iteration, riding DCN (the reference ships whole pickled
+    Populations over TCP for the same purpose, SURVEY.md §2.3)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    return jax.tree_util.tree_map(
+        lambda a: multihost_utils.process_allgather(np.asarray(a), tiled=False),
+        local_pool_arrays,
+    )

@@ -70,9 +70,9 @@ class Node:
         memo[id(self)] = new
         return new
 
-    def count_unique_nodes(self) -> int:
-        """Node count with shared subtrees counted ONCE (GraphNode complexity,
-        reference: shared-node-aware tree_mapreduce in Complexity.jl:17-50)."""
+    def iter_unique(self) -> Iterator["Node"]:
+        """Traversal visiting each node ONCE by identity (O(unique) even on
+        shared-subtree DAGs, unlike __iter__ which expands sharing)."""
         seen: set[int] = set()
         stack = [self]
         while stack:
@@ -80,11 +80,16 @@ class Node:
             if id(n) in seen:
                 continue
             seen.add(id(n))
+            yield n
             if n.degree >= 1:
                 stack.append(n.l)
             if n.degree == 2:
                 stack.append(n.r)
-        return len(seen)
+
+    def count_unique_nodes(self) -> int:
+        """Node count with shared subtrees counted ONCE (GraphNode complexity,
+        reference: shared-node-aware tree_mapreduce in Complexity.jl:17-50)."""
+        return sum(1 for _ in self.iter_unique())
 
     def contains(self, other: "Node") -> bool:
         """True iff `other` (by identity) is reachable from self."""

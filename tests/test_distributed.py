@@ -1,0 +1,26 @@
+"""Multi-host helpers (single-process degenerate behavior + slicing math)."""
+
+import numpy as np
+
+from symbolicregression_jl_tpu.parallel.distributed import (
+    all_gather_migration_pool,
+    initialize,
+    is_distributed,
+    process_island_slice,
+)
+
+
+def test_initialize_noop_single_host():
+    initialize()  # no coordinator configured -> no-op
+    assert not is_distributed()
+
+
+def test_island_slice_single_process():
+    start, stop = process_island_slice(15)
+    assert (start, stop) == (0, 15)
+
+
+def test_allgather_identity_single_process():
+    pool = {"loss": np.arange(4.0), "kind": np.ones((4, 8), np.int32)}
+    out = all_gather_migration_pool(pool)
+    np.testing.assert_array_equal(np.asarray(out["loss"]).reshape(-1, 4)[0], pool["loss"])
