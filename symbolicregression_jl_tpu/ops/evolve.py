@@ -270,9 +270,10 @@ def _mutate_operator(key, tree: Tree, cfg: EvoConfig) -> Tree:
     return tree._replace(op=jnp.where(hits & (n_op > 0), new_op, tree.op))
 
 
-def _swap_operands(key, tree: Tree, cfg: EvoConfig) -> Tree:
+def _swap_operands(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     """Swap the child subtrees of one random binary node
-    (/root/reference/src/MutationFunctions.jl:34-41)."""
+    (/root/reference/src/MutationFunctions.jl:34-41). ``sizes`` is the
+    precomputed subtree_sizes of ``tree``."""
     N = tree.n_slots
     k1 = key
     is_bin = tree.kind == KIND_BINARY
@@ -280,7 +281,6 @@ def _swap_operands(key, tree: Tree, cfg: EvoConfig) -> Tree:
     ranks = jnp.cumsum(is_bin.astype(jnp.int32)) - 1
     pick = jax.random.randint(k1, (), 0, jnp.maximum(n_b, 1))
     p = jnp.argmax(is_bin & (ranks == pick))  # slot of chosen binary node
-    sizes = subtree_sizes(tree)
     # children blocks: A = left subtree, B = right subtree; B ends at p-1
     r_root = tree.rhs[p]
     l_root = tree.lhs[p]
@@ -381,12 +381,11 @@ def _add_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     return jax.tree_util.tree_map(lambda a, b: jnp.where(n_l > 0, a, b), out, tree)
 
 
-def _insert_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
+def _insert_node(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     """insert_random_op: wrap a random subtree in a new operator node
     (/root/reference/src/MutationFunctions.jl:124-143)."""
     N = tree.n_slots
     k1, k2, k3, k4 = jax.random.split(key, 4)
-    sizes = subtree_sizes(tree)
     p = _rand_node(k1, tree.length)
     a = p - sizes[p] + 1
     blk = extract_block(tree, a, p + 1)
@@ -419,7 +418,7 @@ def _insert_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     return replace_range(tree, a, p + 1, mat)
 
 
-def _delete_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
+def _delete_node(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     """delete_random_op: splice a random operator node out, promoting one of
     its children (/root/reference/src/MutationFunctions.jl:191-234)."""
     k1, k2 = jax.random.split(key)
@@ -428,7 +427,6 @@ def _delete_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     ranks = jnp.cumsum(is_op.astype(jnp.int32)) - 1
     pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1))
     p = jnp.argmax(is_op & (ranks == pick))
-    sizes = subtree_sizes(tree)
     keep_right = (tree.kind[p] == KIND_BINARY) & (jax.random.uniform(k2, ()) < 0.5)
     child = jnp.where(keep_right, tree.rhs[p], tree.lhs[p])
     ca = child - sizes[child] + 1
@@ -445,12 +443,11 @@ def _randomize(key, tree: Tree, cfg: EvoConfig, curmaxsize) -> Tree:
     return random_tree(k2, m, tree.n_slots, cfg.nfeatures, cfg.n_unary, cfg.n_binary)
 
 
-def _crossover(key, t1: Tree, t2: Tree, cfg: EvoConfig):
+def _crossover(key, t1: Tree, t2: Tree, cfg: EvoConfig, s1, s2):
     """Swap random subtrees between two trees; returns (child1, child2)
-    (/root/reference/src/MutationFunctions.jl:271-303)."""
+    (/root/reference/src/MutationFunctions.jl:271-303). s1/s2 are the
+    precomputed subtree_sizes of t1/t2."""
     k1, k2 = jax.random.split(key)
-    s1 = subtree_sizes(t1)
-    s2 = subtree_sizes(t2)
     p1 = _rand_node(k1, t1.length)
     p2 = _rand_node(k2, t2.length)
     a1 = p1 - s1[p1] + 1
@@ -492,16 +489,19 @@ def _condition_weights(tree: Tree, cfg: EvoConfig, curmaxsize) -> jax.Array:
 
 
 def _apply_mutation(
-    key, tree: Tree, kind_idx, cfg: EvoConfig, curmaxsize, temperature
+    key, tree: Tree, kind_idx, cfg: EvoConfig, curmaxsize, temperature, sizes
 ) -> Tree:
-    """Dispatch one mutation kind (vmapped callers: all branches trace)."""
+    """Dispatch one mutation kind (vmapped callers: all branches trace).
+    ``sizes`` = precomputed subtree_sizes(tree), shared by the structural
+    branches (the vmapped switch evaluates every branch, so recomputing it
+    inside each one multiplied the N-step forward passes)."""
     branches = [
         lambda k, t: _mutate_constant(k, t, cfg, temperature),
         lambda k, t: _mutate_operator(k, t, cfg),
-        lambda k, t: _swap_operands(k, t, cfg),
+        lambda k, t: _swap_operands(k, t, cfg, sizes),
         lambda k, t: _add_node(k, t, cfg),
-        lambda k, t: _insert_node(k, t, cfg),
-        lambda k, t: _delete_node(k, t, cfg),
+        lambda k, t: _insert_node(k, t, cfg, sizes),
+        lambda k, t: _delete_node(k, t, cfg, sizes),
         lambda k, t: _randomize(k, t, cfg, curmaxsize),
         lambda k, t: t,  # do_nothing
     ]
@@ -564,14 +564,18 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
         w = w.at[M_NOTHING].add(jnp.where(jnp.sum(w) <= 0, 1.0, 0.0))
         return jax.random.choice(k, 8, p=w / jnp.sum(w))
 
+    sizes1 = jax.vmap(subtree_sizes)(parent1)
+    sizes2 = jax.vmap(subtree_sizes)(parent2)
     mut_kinds = jax.vmap(choose_kind)(jax.random.split(k_kind, L), parent1)
     mutated = jax.vmap(
-        lambda k, t, m: _apply_mutation(k, t, m, cfg, curmaxsize, temperature)
-    )(jax.random.split(k_mut, L), parent1, mut_kinds)
+        lambda k, t, m, sz: _apply_mutation(
+            k, t, m, cfg, curmaxsize, temperature, sz
+        )
+    )(jax.random.split(k_mut, L), parent1, mut_kinds, sizes1)
 
     # crossover path (children pair)
-    xo1, xo2 = jax.vmap(lambda k, a, b: _crossover(k, a, b, cfg))(
-        jax.random.split(k_xo, L), parent1, parent2
+    xo1, xo2 = jax.vmap(lambda k, a, b, sa, sb: _crossover(k, a, b, cfg, sa, sb))(
+        jax.random.split(k_xo, L), parent1, parent2, sizes1, sizes2
     )
 
     def pick(a, b, flag):
