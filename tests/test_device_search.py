@@ -78,6 +78,16 @@ def test_device_mode_rejects_unsupported():
         equation_search(X, y, options=opts, niterations=1, verbosity=0)
 
 
+def test_device_search_multi_output():
+    X, y = _problem()
+    Y = np.stack([y, X[0] * 2], axis=0)  # (n_outputs, n)
+    results = equation_search(
+        X, Y, options=_opts(ncycles_per_iteration=30), niterations=2, verbosity=0
+    )
+    assert len(results) == 2
+    assert all(np.isfinite(min(m.loss for m in r.pareto_frontier)) for r in results)
+
+
 def test_device_search_weighted():
     X, y = _problem()
     w = np.ones_like(y)
