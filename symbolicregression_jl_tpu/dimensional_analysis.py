@@ -71,20 +71,29 @@ def _eval_node(
     x_units: list[Quantity],
     sample: list[float],
     opset,
+    allow_wildcards: bool = True,
 ) -> WildcardQuantity:
     if node.degree == 0:
         if node.is_const:
-            # free constant: wildcard (may absorb any units)
-            return WildcardQuantity(float(node.val), DIMENSIONLESS, True, False)
+            # free constant: wildcard (may absorb any units) unless
+            # dimensionless_constants_only forbids it
+            # (/root/reference/src/DimensionalAnalysis.jl:108-116,204)
+            return WildcardQuantity(
+                float(node.val), DIMENSIONLESS, allow_wildcards, False
+            )
         q = x_units[node.feat]
+        # variables are NEVER wildcards, even with dimensionless units
+        # (/root/reference/src/DimensionalAnalysis.jl:117-120)
         return WildcardQuantity(
-            float(sample[node.feat]) * q.value, q.dims, q.dims.dimensionless, False
+            float(sample[node.feat]) * q.value, q.dims, False, False
         )
 
     if node.degree == 1:
-        c = _eval_node(node.l, x_units, sample, opset)
+        c = _eval_node(node.l, x_units, sample, opset, allow_wildcards)
         if c.violates:
             return c
+        if not math.isfinite(c.value):
+            return _violated()
         name = opset.unary[node.op].name
         if name in ("sqrt", "sqrt_abs"):
             return WildcardQuantity(
@@ -112,7 +121,15 @@ def _eval_node(
                 c.wildcard,
                 False,
             )
-        # generic unary (cos, exp, log, ...): needs dimensionless input
+        # generic unary (cos, exp, log, ...): needs dimensionless input.
+        # Deliberate deviation from the reference: we also accept a
+        # dimensionless NON-wildcard input (the reference only applies such
+        # ops through Julia method introspection on WildcardQuantity, which
+        # effectively requires a wildcard,
+        # /root/reference/src/DimensionalAnalysis.jl:132-141); our custom ops
+        # are JAX lambdas we cannot abstractly interpret, and cos(x2) with
+        # dimensionless x2 is semantically sound. Pinned in
+        # tests/test_units.py.
         if c.dimensionless or c.wildcard:
             from .ops.operators import SCALAR_IMPLS
 
@@ -124,49 +141,44 @@ def _eval_node(
             return WildcardQuantity(v, DIMENSIONLESS, False, False)
         return _violated()
 
-    l = _eval_node(node.l, x_units, sample, opset)
+    l = _eval_node(node.l, x_units, sample, opset, allow_wildcards)
     if l.violates:
         return l
-    r = _eval_node(node.r, x_units, sample, opset)
+    r = _eval_node(node.r, x_units, sample, opset, allow_wildcards)
     if r.violates:
         return r
+    if not (math.isfinite(l.value) and math.isfinite(r.value)):
+        return _violated()
     name = opset.binary[node.op].name
     if name in ("add", "+", "plus"):
         return _combine_addsub(l, r, 1.0)
     if name in ("sub", "-"):
         return _combine_addsub(l, r, -1.0)
     if name in ("mult", "*"):
+        # wildcard propagates through * and / with OR — a free constant
+        # times a unitful feature can still absorb units
+        # (/root/reference/src/DimensionalAnalysis.jl:63-69)
         return WildcardQuantity(
-            l.value * r.value, l.dims * r.dims, l.wildcard and r.wildcard, False
+            l.value * r.value, l.dims * r.dims, l.wildcard or r.wildcard, False
         )
     if name in ("div", "/"):
         return WildcardQuantity(
             l.value / r.value if r.value != 0 else math.inf,
             l.dims / r.dims,
-            l.wildcard and r.wildcard,
+            l.wildcard or r.wildcard,
             False,
         )
     if name in ("pow", "^", "safe_pow"):
-        # exponent must be dimensionless; base dims raised by its VALUE
-        # (/root/reference/src/DimensionalAnalysis.jl:93-106)
-        if not (r.dimensionless or r.wildcard):
-            return _violated()
-        if l.dimensionless or l.wildcard:
-            return WildcardQuantity(
-                abs(l.value) ** r.value if l.value != 0 else 0.0,
-                DIMENSIONLESS,
-                l.wildcard and r.wildcard,
-                False,
-            )
-        if not math.isfinite(r.value):
-            return _violated()
-        try:
-            dims = l.dims ** r.value
-        except (ValueError, ZeroDivisionError):
-            return _violated()
-        return WildcardQuantity(
-            abs(l.value) ** r.value if l.value != 0 else 0.0, dims, False, False
-        )
+        # BOTH base and exponent must be dimensionless (or wildcard);
+        # a dimensionful base of ^ is a violation
+        # (/root/reference/src/DimensionalAnalysis.jl:91-102)
+        if (l.dimensionless or l.wildcard) and (r.dimensionless or r.wildcard):
+            try:
+                v = abs(l.value) ** r.value if l.value != 0 else 0.0
+            except OverflowError:
+                v = math.inf
+            return WildcardQuantity(v, DIMENSIONLESS, False, False)
+        return _violated()
     # generic binary: both sides must be dimensionless (or wildcard)
     if (l.dimensionless or l.wildcard) and (r.dimensionless or r.wildcard):
         return WildcardQuantity(l.value, DIMENSIONLESS, False, False)
@@ -187,7 +199,8 @@ def violates_dimensional_constraints(
     if xq is None:
         xq = [Quantity(1.0, DIMENSIONLESS)] * n_feat
     sample = [float(dataset.X[f, 0]) for f in range(n_feat)]
-    out = _eval_node(tree, xq, sample, options.operators)
+    allow_wildcards = not getattr(options, "dimensionless_constants_only", False)
+    out = _eval_node(tree, xq, sample, options.operators, allow_wildcards)
     if out.violates:
         return True
     if yq is not None:

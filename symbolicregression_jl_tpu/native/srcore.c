@@ -37,6 +37,9 @@ typedef struct {
     /* postorder slot map: pointer -> most recent slot (linear probe hash) */
     void *keys[2 * MAX_NODES];
     int32_t slots[2 * MAX_NODES];
+    /* per-call traversal stack: lives in this heap-allocated scratch struct
+     * (not function-static) so emit_tree is reentrant across threads */
+    StackEntry stack[MAX_STACK];
 } SlotMap;
 
 static inline void slotmap_clear(SlotMap *m, int n) {
@@ -98,7 +101,7 @@ static int emit_tree(PyObject *root, int N,
                      int32_t *kind, int32_t *op, int32_t *lhs, int32_t *rhs,
                      int32_t *feat, float *val, int mode, int una_off,
                      SlotMap *map) {
-    static StackEntry stack[MAX_STACK];
+    StackEntry *stack = map->stack;
     int sp = 0;
     int out = 0;
     int err = 0;
@@ -224,11 +227,33 @@ static PyObject *flatten_batch(PyObject *self, PyObject *args) {
 
     {
         Py_ssize_t P = PySequence_Length(trees);
-        int N = (int)(kind.shape ? kind.shape[1] : 0);
+        if (P < 0) goto fail7;
+        if (kind.ndim != 2) {
+            PyErr_SetString(PyExc_ValueError, "srcore: kind must be 2-D [P, N]");
+            goto fail7;
+        }
+        int N = (int)kind.shape[1];
         if (N > MAX_NODES || P > kind.shape[0]) {
             PyErr_Format(PyExc_ValueError,
                          "srcore capacity exceeded (N=%d > %d or P out of range)",
                          N, MAX_NODES);
+            goto fail7;
+        }
+        /* all six [P, N] buffers must share kind's shape, and the length
+         * buffer must hold at least P entries — a smaller array would mean
+         * out-of-bounds C writes instead of a Python error */
+        const Py_buffer *grid[5] = {&op, &lhs, &rhs, &feat, &val};
+        for (int g = 0; g < 5; g++) {
+            if (grid[g]->ndim != 2 || grid[g]->shape[0] != kind.shape[0] ||
+                grid[g]->shape[1] != kind.shape[1]) {
+                PyErr_SetString(PyExc_ValueError,
+                                "srcore: op/lhs/rhs/feat/val shape must match kind");
+                goto fail7;
+            }
+        }
+        if (len.len / (Py_ssize_t)sizeof(int32_t) < P) {
+            PyErr_SetString(PyExc_ValueError,
+                            "srcore: length buffer smaller than number of trees");
             goto fail7;
         }
         SlotMap *map = PyMem_Malloc(sizeof(SlotMap));
@@ -284,6 +309,12 @@ static PyObject *slab_fill(PyObject *self, PyObject *args) {
 
     {
         Py_ssize_t P = PySequence_Length(trees);
+        if (P < 0) goto fail;
+        if (ints.ndim != 2 || vals.ndim != 2) {
+            PyErr_SetString(PyExc_ValueError,
+                            "srcore slab_fill: ints/vals must be 2-D");
+            goto fail;
+        }
         Py_ssize_t L = ints.shape[1];
         Py_ssize_t Lv = vals.shape[1];
         if (N > MAX_NODES || start < 0 || start + P > ints.shape[0] ||

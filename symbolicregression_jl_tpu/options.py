@@ -101,6 +101,10 @@ class Options:
     # units; None -> 1000, the reference default
     # (/root/reference/src/LossFunctions.jl:217-227)
     dimensional_constraint_penalty: float | None = None
+    # forbid free constants from absorbing units (reference
+    # options.dimensionless_constants_only,
+    # /root/reference/src/DimensionalAnalysis.jl:204)
+    dimensionless_constants_only: bool = False
     use_frequency: bool = True
     use_frequency_in_tournament: bool = True
     adaptive_parsimony_scaling: float = 20.0

@@ -101,6 +101,75 @@ class TestDimensionalAnalysis:
         t = binary(MUL, feature(0), feature(1))
         assert not violates_dimensional_constraints(t, ds, OPTS)
 
+    def test_mixed_unit_linear_combination_allowed(self):
+        """c1*x1 + c2*x2 over mixed units is NOT a violation: wildcard
+        propagates through * with OR, so each term can absorb its units
+        (/root/reference/src/DimensionalAnalysis.jl:63-69)."""
+        ds = _ds(X_units=["m", "s"])
+        t = binary(
+            ADD,
+            binary(MUL, constant(1.5), feature(0)),
+            binary(MUL, constant(0.5), feature(1)),
+        )
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+        # and it still satisfies any y unit, since the sum stays wildcard
+        ds2 = _ds(X_units=["m", "s"], y_units="kg")
+        assert not violates_dimensional_constraints(t, ds2, OPTS)
+
+    def test_constant_times_feature_matches_y_units(self):
+        """c * x2 (seconds) must satisfy y in meters via the wildcard
+        constant — the OR propagation rule."""
+        ds = _ds(X_units=["m", "s"], y_units="m")
+        t = binary(MUL, constant(2.0), feature(1))
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+
+    def test_variables_never_wildcard(self):
+        """A dimensionless variable is not a wildcard: it cannot absorb the
+        y units (/root/reference/src/DimensionalAnalysis.jl:117-120)."""
+        ds = _ds(X_units=["m", "1"], y_units="kg")
+        assert violates_dimensional_constraints(feature(1), ds, OPTS)
+
+    def test_pow_dimensionful_base_violates(self):
+        """x1^c with x1 in meters violates: ^ requires base AND exponent
+        dimensionless-or-wildcard
+        (/root/reference/src/DimensionalAnalysis.jl:91-102)."""
+        opts = Options(
+            binary_operators=["+", "-", "*", "^"],
+            unary_operators=["cos"],
+            save_to_file=False,
+        )
+        pow_idx = 3
+        ds = _ds(X_units=["m", "s"])
+        bad = binary(pow_idx, feature(0), constant(3.2))
+        assert violates_dimensional_constraints(bad, ds, opts)
+        # (c*x1)^c is fine: wildcard base
+        good = binary(
+            pow_idx, binary(2, constant(1.0), feature(0)), constant(3.2)
+        )
+        assert not violates_dimensional_constraints(good, ds, opts)
+
+    def test_dimensionless_constants_only(self):
+        """With dimensionless_constants_only, constants stop absorbing
+        units (/root/reference/src/DimensionalAnalysis.jl:204)."""
+        strict = Options(
+            binary_operators=["+", "-", "*", "/"],
+            unary_operators=["cos", "sqrt"],
+            save_to_file=False,
+            dimensionless_constants_only=True,
+        )
+        ds = _ds(X_units=["m", "s"])
+        t = binary(ADD, feature(0), constant(1.5))  # m + c
+        assert not violates_dimensional_constraints(t, ds, OPTS)
+        assert violates_dimensional_constraints(t, ds, strict)
+
+    def test_generic_unary_accepts_dimensionless_nonwildcard(self):
+        """Deliberate deviation pin (see dimensional_analysis.py): cos of a
+        dimensionless NON-wildcard value is accepted."""
+        ds = _ds(X_units=["m", "1"])
+        assert not violates_dimensional_constraints(
+            unary(COS, feature(1)), ds, OPTS
+        )
+
 
 def test_search_with_units_penalizes_violations():
     """Planted y = 2*x1 with x1 in meters, y in meters: the dimensional
