@@ -79,6 +79,11 @@ class Dataset:
         With `sharding`, arrays are placed row-sharded across the mesh."""
         key = (np.dtype(dtype), id(sharding))
         if key not in self._device_cache:
+            # guard at the truncation point: without x64, jnp.asarray would
+            # silently truncate a requested f64 to f32 and poison this cache
+            from .utils.precision import ensure_x64_for_dtype
+
+            ensure_x64_for_dtype(dtype)
             X = jnp.asarray(self.X.astype(dtype))
             y = None if self.y is None else jnp.asarray(self.y.astype(dtype))
             w = (

@@ -469,7 +469,11 @@ def device_search_one_output(
                 f"[device iter {it + 1}/{niterations}] evals={num_evals:.3g} "
                 f"elapsed={elapsed:.1f}s evals/s={num_evals / max(elapsed, 1e-9):.3g}"
             )
-            print(hof.render(options, dataset.variable_names))
+            print(
+                hof.render(
+                    options, dataset.variable_names, dataset.y_variable_name
+                )
+            )
 
         if early_stop is not None and any(
             early_stop(m.loss, m.get_complexity(options))

@@ -100,17 +100,21 @@ class HallOfFame:
             prev_loss, prev_c = loss, c
         return rows
 
-    def render(self, options, variable_names=None) -> str:
+    def render(self, options, variable_names=None, y_variable_name=None) -> str:
         """Terminal table (reference: string_dominating_pareto_curve,
-        /root/reference/src/HallOfFame.jl:105-153)."""
+        /root/reference/src/HallOfFame.jl:105-153). Equations are prefixed
+        ``<y_variable_name> = `` like the reference's live Pareto table
+        (/root/reference/src/HallOfFame.jl:128-134)."""
         rows = self.format(options, variable_names)
+        prefix = f"{y_variable_name} = " if y_variable_name else ""
         lines = [
             "-" * 72,
             f"{'Complexity':<12}{'Loss':<14}{'Score':<14}Equation",
         ]
         for r in rows:
             lines.append(
-                f"{r['complexity']:<12}{r['loss']:<14.6g}{r['score']:<14.6g}{r['equation']}"
+                f"{r['complexity']:<12}{r['loss']:<14.6g}{r['score']:<14.6g}"
+                f"{prefix}{r['equation']}"
             )
         lines.append("-" * 72)
         return "\n".join(lines)

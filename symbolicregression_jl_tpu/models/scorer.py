@@ -23,6 +23,7 @@ from ..ops.flat import batch_bucket as _bucket
 from ..ops.flat import flatten_trees
 from ..ops.scoring import batched_loss_jit, baseline_loss, loss_to_score
 from ..tree import Node
+from ..utils.precision import ensure_x64_for_dtype
 
 __all__ = ["BatchScorer"]
 
@@ -34,6 +35,7 @@ class BatchScorer:
         self.opset = options.operators
         self.loss_elem = options.loss
         self.dtype = options.dtype
+        ensure_x64_for_dtype(self.dtype)
         self.max_nodes = options.max_nodes
         X, y, w = dataset.device_arrays(self.dtype)
         self.X, self.y, self.w = X, y, w

@@ -160,7 +160,10 @@ def async_search_one_output(
                     )
             if output_file and options.save_to_file:
                 save_hall_of_fame(output_file, hof, options, dataset.variable_names)
-            reporter.update(hof, scorer.num_evals, dataset.variable_names)
+            reporter.update(
+                hof, scorer.num_evals, dataset.variable_names,
+                y_variable_name=dataset.y_variable_name,
+            )
             # stop conditions (reference :1053-1060)
             if early_stop is not None and any(
                 early_stop(m.loss, m.get_complexity(options))

@@ -211,6 +211,7 @@ def _search_one_output(
             scorer.num_evals,
             dataset.variable_names,
             force=iteration == niterations - 1,
+            y_variable_name=dataset.y_variable_name,
         )
 
         # stop conditions (reference: /root/reference/src/SearchUtils.jl:190-212)
@@ -242,6 +243,21 @@ def _search_one_output(
     return result
 
 
+#: reference parallelism names -> scheduler (``parallelism`` resolution,
+#: /root/reference/src/SymbolicRegression.jl:465-488). ``:serial`` is the
+#: deterministic lockstep driver; ``:multithreading`` maps to the async
+#: thread-pool island scheduler; ``:multiprocessing`` (multi-host SPMD via
+#: jax.distributed) runs the lockstep driver with per-process island slicing.
+_PARALLELISM_TO_SCHEDULER = {
+    "serial": "lockstep",
+    "multithreading": "async",
+    "multiprocessing": "lockstep",
+    "lockstep": "lockstep",
+    "async": "async",
+    "device": "device",
+}
+
+
 def equation_search(
     X,
     y,
@@ -252,9 +268,8 @@ def equation_search(
     variable_names: list[str] | None = None,
     y_variable_names=None,
     saved_state=None,
-    return_state: bool | None = None,
     verbosity: int | None = None,
-    parallelism: str = "lockstep",
+    parallelism: str | None = None,
     X_units=None,
     y_units=None,
 ) -> Any:
@@ -264,9 +279,26 @@ def equation_search(
     X: (n_features, n). y: (n,) or (n_outputs, n) — multi-output runs one
     independent search per output row (reference: construct_datasets,
     /root/reference/src/SearchUtils.jl:472-511). Returns SearchResult, or a
-    list of SearchResult for multi-output.
+    list of SearchResult for multi-output — state (populations + hall of
+    fame) is always included, so there is no ``return_state`` flag.
+
+    ``parallelism`` accepts the reference mode names (``"serial"``,
+    ``"multithreading"``, ``"multiprocessing"``) or a scheduler name and
+    overrides ``options.scheduler``; ``None`` keeps the options value.
+    ``y_variable_names`` names the output variable(s) for rendering (str, or
+    list with one entry per output row).
     """
     options = options or Options()
+    if parallelism is not None:
+        try:
+            scheduler = _PARALLELISM_TO_SCHEDULER[parallelism]
+        except KeyError:
+            raise ValueError(
+                f"unknown parallelism {parallelism!r}; expected one of "
+                f"{sorted(_PARALLELISM_TO_SCHEDULER)}"
+            ) from None
+        if scheduler != options.scheduler:
+            options = dataclasses.replace(options, scheduler=scheduler)
     X = np.asarray(X)
     y = np.asarray(y)
     multi_output = y.ndim == 2
@@ -302,6 +334,17 @@ def equation_search(
     if saved is not None and not isinstance(saved, (list, tuple)):
         saved = [saved]
 
+    if y_variable_names is None:
+        y_names = [None] * nout
+    elif isinstance(y_variable_names, str):
+        y_names = [y_variable_names] * nout
+    else:
+        y_names = list(y_variable_names)
+        if len(y_names) != nout:
+            raise ValueError(
+                f"y_variable_names has {len(y_names)} entries for {nout} outputs"
+            )
+
     results = []
     for j in range(nout):
         dataset = Dataset(
@@ -309,6 +352,7 @@ def equation_search(
             ys[j],
             weights=ws[j] if weights is not None else None,
             variable_names=variable_names,
+            y_variable_name=y_names[j],
             X_units=X_units,
             y_units=y_units[j] if isinstance(y_units, (list, tuple)) else y_units,
         )

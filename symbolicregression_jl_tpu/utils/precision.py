@@ -1,0 +1,30 @@
+"""Compute-dtype honesty: float64 actually computes in float64.
+
+JAX truncates requested f64 to f32 unless ``jax_enable_x64`` is set; the
+reference computes natively in whatever ``T`` the dataset carries
+(Float16/32/64 sweep, /root/reference/test/test_mixed.jl:6-150). We flip the
+global flag the first time an f64 search is requested — JAX 0.9 removed the
+scoped ``jax.experimental.enable_x64`` context manager, and per-call scoping
+would leak across the async scheduler's threads anyway. Enabling x64 does not
+change the dtype of existing f32/f16 programs (arrays keep their explicit
+dtypes; Python scalars stay weak-typed).
+
+On TPU hardware f64 is emulated (no native f64 ALUs) — correct but slow;
+that is the same trade the reference makes on GPUs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ensure_x64_for_dtype"]
+
+
+def ensure_x64_for_dtype(dtype) -> None:
+    """Enable jax_enable_x64 when `dtype` needs 64-bit compute."""
+    if np.dtype(dtype).itemsize < 8:
+        return
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        jax.config.update("jax_enable_x64", True)

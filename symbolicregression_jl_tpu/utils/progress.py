@@ -60,7 +60,10 @@ class ProgressReporter:
 
     # -- updates --------------------------------------------------------------
 
-    def update(self, hof, num_evals: float, variable_names=None, force=False) -> None:
+    def update(
+        self, hof, num_evals: float, variable_names=None, force=False,
+        y_variable_name=None,
+    ) -> None:
         self.done += 1
         if self.verbosity <= 0:
             return
@@ -77,7 +80,7 @@ class ProgressReporter:
                 f"evals/s={evals_s:.3g} elapsed={elapsed:.0f}s "
                 f"occupancy={self.occupancy:.0%}\n"
             )
-            print(hof.render(self.options, variable_names))
+            print(hof.render(self.options, variable_names, y_variable_name))
             sys.stdout.flush()
         else:
             # plain mode: full state at most every 5 seconds (:1026-1048)
@@ -88,4 +91,4 @@ class ProgressReporter:
                 f"[{self.done}/{self.total}] evals={num_evals:.3g} "
                 f"elapsed={elapsed:.1f}s evals/s={evals_s:.3g}"
             )
-            print(hof.render(self.options, variable_names))
+            print(hof.render(self.options, variable_names, y_variable_name))

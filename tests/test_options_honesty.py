@@ -108,3 +108,65 @@ def test_f_calls_limit_respected():
 def test_bad_optimizer_algorithm_rejected():
     with pytest.raises(ValueError, match="optimizer_algorithm"):
         Options(optimizer_algorithm="LBFGS")
+
+
+class TestEquationSearchKwargs:
+    """The public kwargs are observable in behavior (no phantom surface):
+    parallelism maps to a scheduler, y_variable_names reaches the dataset
+    and render, return_state is gone (state is always returned)."""
+
+    def _xy(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 40)).astype(np.float32)
+        return X, (2 * X[0]).astype(np.float32)
+
+    def test_parallelism_serial_maps_to_lockstep(self):
+        X, y = self._xy()
+        opts = Options(
+            binary_operators=["+", "*"], populations=2, population_size=8,
+            ncycles_per_iteration=10, save_to_file=False, seed=0,
+            scheduler="async",
+        )
+        # parallelism="serial" must override the async scheduler: the run
+        # becomes deterministic lockstep -> two runs produce identical fronts
+        r1 = equation_search(X, y, options=opts, niterations=2, verbosity=0,
+                             parallelism="serial")
+        r2 = equation_search(X, y, options=opts, niterations=2, verbosity=0,
+                             parallelism="serial")
+        f1 = [(m.get_complexity(opts), m.loss) for m in r1.pareto_frontier]
+        f2 = [(m.get_complexity(opts), m.loss) for m in r2.pareto_frontier]
+        assert f1 == f2
+
+    def test_parallelism_unknown_rejected(self):
+        X, y = self._xy()
+        with pytest.raises(ValueError, match="parallelism"):
+            equation_search(X, y, options=Options(save_to_file=False),
+                            niterations=1, verbosity=0, parallelism="gpu")
+
+    def test_return_state_kwarg_removed(self):
+        X, y = self._xy()
+        with pytest.raises(TypeError):
+            equation_search(X, y, options=Options(save_to_file=False),
+                            niterations=1, verbosity=0, return_state=True)
+
+    def test_y_variable_names_reaches_dataset_and_render(self):
+        X, y = self._xy()
+        opts = Options(
+            binary_operators=["+", "*"], populations=2, population_size=8,
+            ncycles_per_iteration=10, save_to_file=False, seed=0,
+        )
+        res = equation_search(X, y, options=opts, niterations=1, verbosity=0,
+                              y_variable_names="flux")
+        assert res.dataset.y_variable_name == "flux"
+        rendered = res.hall_of_fame.render(
+            opts, res.dataset.variable_names, res.dataset.y_variable_name
+        )
+        assert "flux = " in rendered
+
+    def test_y_variable_names_multi_output_length_checked(self):
+        X, y = self._xy()
+        Y = np.stack([y, y + 1])
+        with pytest.raises(ValueError, match="y_variable_names"):
+            equation_search(X, Y, options=Options(save_to_file=False),
+                            niterations=1, verbosity=0,
+                            y_variable_names=["a"])

@@ -109,6 +109,33 @@ class TestDtypeSweep:
         if dtype == np.float64:
             assert best < 1.0
 
+    def test_float64_computes_in_float64_on_device(self):
+        """dtype=float64 must actually compute in f64, not silently truncate
+        to f32 (reference computes natively in T, test_mixed.jl:6-150)."""
+        import jax.numpy as jnp
+
+        from symbolicregression_jl_tpu.models.scorer import BatchScorer
+        from symbolicregression_jl_tpu.dataset import Dataset
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 40))
+        y = X[0] * 2 + 1
+        opts = Options(
+            binary_operators=["+", "*"],
+            save_to_file=False,
+            dtype=np.float64,
+        )
+        scorer = BatchScorer(Dataset(X, y), opts)
+        assert scorer.X.dtype == jnp.float64
+        assert scorer.y.dtype == jnp.float64
+        # and a scored loss comes back at f64 resolution: representable
+        # difference below f32 eps must survive
+        from symbolicregression_jl_tpu.tree import binary, constant, feature
+
+        t = binary(1, binary(0, feature(0), feature(0)), constant(1.0))
+        losses = scorer.loss_many([t])
+        assert np.asarray(losses).dtype == np.float64
+
 
 def test_annealing_end_to_end():
     """annealing=True accept rule exercised through a full recovery
