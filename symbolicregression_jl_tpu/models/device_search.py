@@ -441,11 +441,30 @@ def device_search_one_output(
 
     hof = HallOfFame(options.maxsize)
     if saved_state is not None:
-        # seed from the saved hall of fame (reference warm start re-ingests it,
-        # /root/reference/src/SymbolicRegression.jl:727-744; dataset unchanged
-        # here so stored losses remain valid)
-        for m in saved_state.hall_of_fame.members:
-            if m is not None:
+        # re-ingest the saved hall of fame, RESCORING each member against
+        # this dataset — the reference rescores on warm start precisely
+        # because the dataset may have changed
+        # (/root/reference/src/SymbolicRegression.jl:727-744). One extra
+        # device call before the loop; the per-iteration readback below is
+        # the first D2H either way.
+        saved_members = [
+            m.copy()
+            for m in saved_state.hall_of_fame.members
+            if m is not None
+        ]
+        if saved_members:
+            sflat = flatten_trees([m.tree for m in saved_members], N)
+            sbatch = Tree(
+                jnp.asarray(sflat.kind), jnp.asarray(sflat.op),
+                jnp.asarray(sflat.lhs), jnp.asarray(sflat.rhs),
+                jnp.asarray(sflat.feat), jnp.asarray(sflat.val),
+                jnp.asarray(sflat.length),
+            )
+            slosses = np.asarray(jax.jit(score_fn)(sbatch))
+            for m, loss in zip(saved_members, slosses):
+                comp = m.get_complexity(options)
+                m.loss = float(loss)
+                m.score = float(_score_of(float(loss), float(comp), cfg))
                 hof.update(m, options)
     early_stop = options.early_stop_fn()
     start_time = time.time()

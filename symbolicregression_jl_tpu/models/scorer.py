@@ -23,7 +23,6 @@ from ..ops.flat import batch_bucket as _bucket
 from ..ops.flat import flatten_trees
 from ..ops.scoring import batched_loss_jit, baseline_loss, loss_to_score
 from ..tree import Node
-from ..utils.precision import ensure_x64_for_dtype
 
 __all__ = ["BatchScorer"]
 
@@ -35,7 +34,6 @@ class BatchScorer:
         self.opset = options.operators
         self.loss_elem = options.loss
         self.dtype = options.dtype
-        ensure_x64_for_dtype(self.dtype)
         self.max_nodes = options.max_nodes
         X, y, w = dataset.device_arrays(self.dtype)
         self.X, self.y, self.w = X, y, w

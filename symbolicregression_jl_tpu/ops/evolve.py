@@ -212,7 +212,7 @@ def _tournament(key, score, length, freq, cfg: EvoConfig):
     n = cfg.tournament_n
     k1, k2 = jax.random.split(key)
     # n distinct members via random-key argsort
-    order = jnp.argsort(jax.random.uniform(k1, (P,)))
+    order = jnp.argsort(jax.random.uniform(k1, (P,), dtype=jnp.float32))
     cand = order[:n]
     s = score[cand]
     if cfg.use_frequency_in_tournament:
@@ -232,7 +232,7 @@ def _tournament(key, score, length, freq, cfg: EvoConfig):
 
 
 def _rand_node(key, length):
-    return jax.random.randint(key, (), 0, jnp.maximum(length, 1))
+    return jax.random.randint(key, (), 0, jnp.maximum(length, 1), dtype=jnp.int32)
 
 
 def _mutate_constant(key, tree: Tree, cfg: EvoConfig, temperature) -> Tree:
@@ -245,12 +245,12 @@ def _mutate_constant(key, tree: Tree, cfg: EvoConfig, temperature) -> Tree:
     n_c = jnp.sum(is_c)
     # index of a random constant slot
     ranks = jnp.cumsum(is_c.astype(jnp.int32)) - 1
-    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_c, 1))
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_c, 1), dtype=jnp.int32)
     slot_hits = is_c & (ranks == pick)
     max_change = cfg.perturbation_factor * temperature + 1.0 + 0.1
-    factor = max_change ** jax.random.uniform(k2, ())
-    factor = jnp.where(jax.random.uniform(k4, ()) < 0.5, factor, 1.0 / factor)
-    neg = jax.random.uniform(k3, ()) < cfg.probability_negate_constant
+    factor = max_change ** jax.random.uniform(k2, (), dtype=jnp.float32)
+    factor = jnp.where(jax.random.uniform(k4, (), dtype=jnp.float32) < 0.5, factor, 1.0 / factor)
+    neg = jax.random.uniform(k3, (), dtype=jnp.float32) < cfg.probability_negate_constant
     newval = tree.val * jnp.where(slot_hits, factor * jnp.where(neg, -1.0, 1.0), 1.0)
     return tree._replace(val=jnp.where(n_c > 0, newval, tree.val))
 
@@ -262,10 +262,10 @@ def _mutate_operator(key, tree: Tree, cfg: EvoConfig) -> Tree:
     is_op = tree.kind >= KIND_UNARY
     n_op = jnp.sum(is_op)
     ranks = jnp.cumsum(is_op.astype(jnp.int32)) - 1
-    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1))
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1), dtype=jnp.int32)
     hits = is_op & (ranks == pick)
-    new_un = jax.random.randint(k2, (), 0, max(cfg.n_unary, 1))
-    new_bin = jax.random.randint(k3, (), 0, max(cfg.n_binary, 1))
+    new_un = jax.random.randint(k2, (), 0, max(cfg.n_unary, 1), dtype=jnp.int32)
+    new_bin = jax.random.randint(k3, (), 0, max(cfg.n_binary, 1), dtype=jnp.int32)
     new_op = jnp.where(tree.kind == KIND_UNARY, new_un, new_bin)
     return tree._replace(op=jnp.where(hits & (n_op > 0), new_op, tree.op))
 
@@ -279,7 +279,7 @@ def _swap_operands(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     is_bin = tree.kind == KIND_BINARY
     n_b = jnp.sum(is_bin)
     ranks = jnp.cumsum(is_bin.astype(jnp.int32)) - 1
-    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_b, 1))
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_b, 1), dtype=jnp.int32)
     p = jnp.argmax(is_bin & (ranks == pick))  # slot of chosen binary node
     # children blocks: A = left subtree, B = right subtree; B ends at p-1
     r_root = tree.rhs[p]
@@ -324,14 +324,14 @@ def _swap_operands(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
 def _leaf_material(key, cfg: EvoConfig, n_slots: int) -> Tree:
     """One random leaf (50/50 const/feature) as a 1-node block."""
     k1, k2, k3 = jax.random.split(key, 3)
-    is_const = jax.random.uniform(k1, ()) < 0.5
+    is_const = jax.random.uniform(k1, (), dtype=jnp.float32) < 0.5
     if cfg.nfeatures <= 0:
         is_const = jnp.asarray(True)
     N = n_slots
     z = jnp.zeros((N,), jnp.int32)
     kind = z.at[0].set(jnp.where(is_const, KIND_CONST, KIND_VAR))
-    feat = z.at[0].set(jax.random.randint(k2, (), 0, max(cfg.nfeatures, 1)))
-    val = jnp.zeros((N,), jnp.float32).at[0].set(jax.random.normal(k3, ()))
+    feat = z.at[0].set(jax.random.randint(k2, (), 0, max(cfg.nfeatures, 1), dtype=jnp.int32))
+    val = jnp.zeros((N,), jnp.float32).at[0].set(jax.random.normal(k3, (), dtype=jnp.float32))
     return Tree(kind, z, z, z, feat, val, jnp.asarray(1, jnp.int32))
 
 
@@ -343,10 +343,10 @@ def _add_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     is_leaf = (tree.kind == KIND_CONST) | (tree.kind == KIND_VAR)
     n_l = jnp.sum(is_leaf)
     ranks = jnp.cumsum(is_leaf.astype(jnp.int32)) - 1
-    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_l, 1))
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_l, 1), dtype=jnp.int32)
     p = jnp.argmax(is_leaf & (ranks == pick))
     # material: binary(leaf, leaf) or unary(leaf)
-    use_bin = jax.random.uniform(k2, ()) < (
+    use_bin = jax.random.uniform(k2, (), dtype=jnp.float32) < (
         cfg.n_binary / max(cfg.n_binary + cfg.n_unary, 1)
     )
     if cfg.n_unary == 0:
@@ -356,8 +356,8 @@ def _add_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     l1 = _leaf_material(k3, cfg, N)
     l2 = _leaf_material(k4, cfg, N)
     ko1, ko2 = jax.random.split(k5)
-    opb = jax.random.randint(ko1, (), 0, max(cfg.n_binary, 1))
-    opu = jax.random.randint(ko2, (), 0, max(cfg.n_unary, 1))
+    opb = jax.random.randint(ko1, (), 0, max(cfg.n_binary, 1), dtype=jnp.int32)
+    opu = jax.random.randint(ko2, (), 0, max(cfg.n_unary, 1), dtype=jnp.int32)
     # build material arrays: [leaf1, leaf2, op] (binary) or [leaf1, op] (unary)
     m_len = jnp.where(use_bin, 3, 2)
     root = m_len - 1
@@ -390,7 +390,7 @@ def _insert_node(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     a = p - sizes[p] + 1
     blk = extract_block(tree, a, p + 1)
     blen = blk.length
-    use_bin = jax.random.uniform(k2, ()) < (
+    use_bin = jax.random.uniform(k2, (), dtype=jnp.float32) < (
         cfg.n_binary / max(cfg.n_binary + cfg.n_unary, 1)
     )
     if cfg.n_unary == 0:
@@ -399,8 +399,8 @@ def _insert_node(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
         use_bin = jnp.asarray(False)
     leaf = _leaf_material(k3, cfg, N)
     ko1, ko2 = jax.random.split(k4)
-    opb = jax.random.randint(ko1, (), 0, max(cfg.n_binary, 1))
-    opu = jax.random.randint(ko2, (), 0, max(cfg.n_unary, 1))
+    opb = jax.random.randint(ko1, (), 0, max(cfg.n_binary, 1), dtype=jnp.int32)
+    opu = jax.random.randint(ko2, (), 0, max(cfg.n_unary, 1), dtype=jnp.int32)
     # material: [block..., leaf?, op]; binary child order (block, leaf)
     j = lax.iota(jnp.int32, N)
     leaf_pos = blen
@@ -425,9 +425,9 @@ def _delete_node(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     is_op = tree.kind >= KIND_UNARY
     n_op = jnp.sum(is_op)
     ranks = jnp.cumsum(is_op.astype(jnp.int32)) - 1
-    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1))
+    pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1), dtype=jnp.int32)
     p = jnp.argmax(is_op & (ranks == pick))
-    keep_right = (tree.kind[p] == KIND_BINARY) & (jax.random.uniform(k2, ()) < 0.5)
+    keep_right = (tree.kind[p] == KIND_BINARY) & (jax.random.uniform(k2, (), dtype=jnp.float32) < 0.5)
     child = jnp.where(keep_right, tree.rhs[p], tree.lhs[p])
     ca = child - sizes[child] + 1
     blk = extract_block(tree, ca, child + 1)
@@ -439,7 +439,7 @@ def _randomize(key, tree: Tree, cfg: EvoConfig, curmaxsize) -> Tree:
     """Fresh random tree (/root/reference/src/Mutate.jl randomize branch);
     size ~ U[1, curmaxsize] capped by slots."""
     k1, k2 = jax.random.split(key)
-    m = jax.random.randint(k1, (), 1, jnp.maximum(curmaxsize, 1) + 1)
+    m = jax.random.randint(k1, (), 1, jnp.maximum(curmaxsize, 1) + 1, dtype=jnp.int32)
     return random_tree(k2, m, tree.n_slots, cfg.nfeatures, cfg.n_unary, cfg.n_binary)
 
 
@@ -495,15 +495,27 @@ def _apply_mutation(
     ``sizes`` = precomputed subtree_sizes(tree), shared by the structural
     branches (the vmapped switch evaluates every branch, so recomputing it
     inside each one multiplied the N-step forward passes)."""
+    def canon(t: Tree) -> Tree:
+        # pin canonical dtypes: scalar-index arithmetic (argmax-derived
+        # positions) silently promotes int32 arrays to int64 when the
+        # process has jax_enable_x64 on (f64 host searches), and lax.switch
+        # requires identical branch output types. No-op casts are free.
+        return Tree(
+            t.kind.astype(jnp.int32), t.op.astype(jnp.int32),
+            t.lhs.astype(jnp.int32), t.rhs.astype(jnp.int32),
+            t.feat.astype(jnp.int32), t.val.astype(jnp.float32),
+            t.length.astype(jnp.int32),
+        )
+
     branches = [
-        lambda k, t: _mutate_constant(k, t, cfg, temperature),
-        lambda k, t: _mutate_operator(k, t, cfg),
-        lambda k, t: _swap_operands(k, t, cfg, sizes),
-        lambda k, t: _add_node(k, t, cfg),
-        lambda k, t: _insert_node(k, t, cfg, sizes),
-        lambda k, t: _delete_node(k, t, cfg, sizes),
-        lambda k, t: _randomize(k, t, cfg, curmaxsize),
-        lambda k, t: t,  # do_nothing
+        lambda k, t: canon(_mutate_constant(k, t, cfg, temperature)),
+        lambda k, t: canon(_mutate_operator(k, t, cfg)),
+        lambda k, t: canon(_swap_operands(k, t, cfg, sizes)),
+        lambda k, t: canon(_add_node(k, t, cfg)),
+        lambda k, t: canon(_insert_node(k, t, cfg, sizes)),
+        lambda k, t: canon(_delete_node(k, t, cfg, sizes)),
+        lambda k, t: canon(_randomize(k, t, cfg, curmaxsize)),
+        lambda k, t: canon(t),  # do_nothing
     ]
     return lax.switch(kind_idx, branches, key, tree)
 
@@ -543,7 +555,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
         jax.random.split(k_t2, L), score_r, length_r
     )
 
-    isl = jnp.repeat(jnp.arange(I), E)  # island of each lane
+    isl = jnp.repeat(jnp.arange(I, dtype=jnp.int32), E)  # island of each lane
     parent1 = jax.vmap(lambda i, p: _member_tree(state, i, p))(isl, win1)
     parent2 = jax.vmap(lambda i, p: _member_tree(state, i, p))(isl, win2)
     pscore1 = state.score[isl, win1]
@@ -552,7 +564,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     ploss2 = state.loss[isl, win2]
 
     do_xover = (
-        jax.random.uniform(k_flip, (L,)) < cfg.crossover_probability
+        jax.random.uniform(k_flip, (L,), dtype=jnp.float32) < cfg.crossover_probability
         if cfg.crossover_probability > 0 and can_pair
         else jnp.zeros((L,), bool)
     )
@@ -635,7 +647,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
         old_f = jnp.maximum(fnorm[sz_old], 1e-6)
         new_f = jnp.maximum(fnorm[sz_new], 1e-6)
         prob = prob * (old_f / new_f)
-    u = jax.random.uniform(k_acc, (L,))
+    u = jax.random.uniform(k_acc, (L,), dtype=jnp.float32)
     accept1 = ~(prob < u) & jnp.isfinite(loss1) & ok1
     accept1 = jnp.where(do_xover, jnp.isfinite(loss1) & ok1, accept1)
     accept2 = do_xover & jnp.isfinite(loss2) & ok2
@@ -653,7 +665,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     # scatters without collisions ---------------------------------------------
     order = jnp.argsort(state.birth, axis=1)  # [I, P], oldest first
     stride = 2 if can_pair else 1
-    lane_e = jnp.arange(L) % E  # e of each lane (lanes are i*E+e)
+    lane_e = jnp.arange(L, dtype=jnp.int32) % E  # e of each lane (lanes are i*E+e)
     idx1 = jnp.clip(stride * lane_e, 0, P - 1)
     idx2 = jnp.clip(stride * lane_e + 1, 0, P - 1)  # only read when can_pair
     slot1 = order[isl, idx1]
@@ -706,7 +718,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     )
     sizes_all = jnp.clip(batch.length, 0, cfg.maxsize)
     S1 = cfg.maxsize + 1
-    size_mask = sizes_all[None, :] == jnp.arange(S1)[:, None]  # [S1, 2I]
+    size_mask = sizes_all[None, :] == jnp.arange(S1, dtype=sizes_all.dtype)[:, None]  # [S1, 2I]
     cand_loss = jnp.where(size_mask & all_valid[None, :], all_loss[None, :], jnp.inf)
     best_idx = jnp.argmin(cand_loss, axis=1)  # [S1]
     best_loss_s = jnp.min(cand_loss, axis=1)
@@ -807,7 +819,7 @@ def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
     else:
         k = cfg.topn
         top_idx = jnp.argsort(state.score, axis=1)[:, :k]  # [I, k]
-        isl = jnp.arange(I)[:, None]
+        isl = jnp.arange(I, dtype=jnp.int32)[:, None]
         pool_kind = state.kind[isl, top_idx].reshape(I * k, N)
         pool_op = state.op[isl, top_idx].reshape(I * k, N)
         pool_lhs = state.lhs[isl, top_idx].reshape(I * k, N)
@@ -820,7 +832,7 @@ def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
         pool_valid = jnp.isfinite(pool_loss)
 
     # Bernoulli(frac) per member (reference draws a Poisson count: same mean)
-    replace = jax.random.uniform(k_sel, (I, P)) < frac
+    replace = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32) < frac
     # never replace into islands from an empty pool
     any_valid = jnp.any(pool_valid)
     replace = replace & any_valid

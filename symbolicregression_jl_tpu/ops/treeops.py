@@ -209,7 +209,7 @@ def random_tree(
         m = jnp.where(m % 2 == 0, jnp.maximum(m - 1, 1), m)  # need u = 0
         b = (m - 1) // 2
     else:
-        b = jax.random.randint(k_b, (), 0, jnp.maximum((m - 1) // 2 + 1, 1))
+        b = jax.random.randint(k_b, (), 0, jnp.maximum((m - 1) // 2 + 1, 1), dtype=jnp.int32)
     u = m - 1 - 2 * b
 
     j = _iota(N)
@@ -220,7 +220,7 @@ def random_tree(
     live = j < m
 
     # shuffle the first m entries (pads sort to the end via +inf keys)
-    keys = jnp.where(live, jax.random.uniform(k_shuf, (N,)), jnp.inf)
+    keys = jnp.where(live, jax.random.uniform(k_shuf, (N,), dtype=jnp.float32), jnp.inf)
     perm = jnp.argsort(keys)
     arity = jnp.where(live, arity[perm], 0)
 
@@ -239,7 +239,7 @@ def random_tree(
     is_bin = arity == 2
     is_un = arity == 1
     is_leaf = live & (arity == 0)
-    const_mask = jax.random.uniform(k_leaf, (N,)) < 0.5
+    const_mask = jax.random.uniform(k_leaf, (N,), dtype=jnp.float32) < 0.5
     if nfeatures <= 0:
         const_mask = jnp.ones((N,), bool)
     kind = jnp.where(
@@ -255,10 +255,10 @@ def random_tree(
     k1, k2, k3 = jax.random.split(k_ops, 3)
     op = jnp.where(
         is_bin,
-        jax.random.randint(k1, (N,), 0, max(n_binary, 1)),
-        jax.random.randint(k2, (N,), 0, max(n_unary, 1)),
+        jax.random.randint(k1, (N,), 0, max(n_binary, 1), dtype=jnp.int32),
+        jax.random.randint(k2, (N,), 0, max(n_unary, 1), dtype=jnp.int32),
     ).astype(jnp.int32)
-    feat = jax.random.randint(k3, (N,), 0, max(nfeatures, 1)).astype(jnp.int32)
+    feat = jax.random.randint(k3, (N,), 0, max(nfeatures, 1), dtype=jnp.int32).astype(jnp.int32)
     # independent key for values: reusing k_leaf here would correlate the
     # const/var coin with the value's sign (all constants would be negative)
     val = jax.random.normal(k_val, (N,), jnp.float32)

@@ -73,8 +73,20 @@ def async_search_one_output(
                 pops.append(_rescore_population(pop, scorer, options))
         while len(pops) < n_islands:
             pops.append(_init_population(scorer, options, nfeatures, rng))
-        for m in saved_state.hall_of_fame.members:
-            if m is not None:
+        # rescore saved hof members against THIS dataset, on copies — same
+        # contract as lockstep/device warm start (reference:
+        # /root/reference/src/SymbolicRegression.jl:727-744)
+        saved_members = [
+            m.copy()
+            for m in saved_state.hall_of_fame.members
+            if m is not None
+        ]
+        if saved_members:
+            losses = scorer.loss_many([m.tree for m in saved_members])
+            comps = [m.get_complexity(options) for m in saved_members]
+            scores = scorer.score_of(losses, np.asarray(comps))
+            for m, l, s in zip(saved_members, losses, scores):
+                m.loss, m.score = float(l), float(s)
                 hof.update(m, options)
     else:
         pops = [

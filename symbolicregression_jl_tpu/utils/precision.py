@@ -5,9 +5,11 @@ reference computes natively in whatever ``T`` the dataset carries
 (Float16/32/64 sweep, /root/reference/test/test_mixed.jl:6-150). We flip the
 global flag the first time an f64 search is requested — JAX 0.9 removed the
 scoped ``jax.experimental.enable_x64`` context manager, and per-call scoping
-would leak across the async scheduler's threads anyway. Enabling x64 does not
-change the dtype of existing f32/f16 programs (arrays keep their explicit
-dtypes; Python scalars stay weak-typed).
+would leak across the async scheduler's threads anyway. Enabling x64 is safe
+for this package's other programs because every jnp constructor in the ops
+layer passes an explicit dtype (dtype-less ``jnp.arange``/``zeros`` would
+start producing int64/f64 under the flag — keep them explicit); Python
+scalars stay weak-typed.
 
 On TPU hardware f64 is emulated (no native f64 ALUs) — correct but slow;
 that is the same trade the reference makes on GPUs.

@@ -53,3 +53,41 @@ def test_async_early_stop():
 def test_unknown_scheduler_rejected():
     with pytest.raises(ValueError, match="unknown scheduler"):
         Options(scheduler="devive")
+
+
+def test_async_warm_start_rescores_on_changed_dataset():
+    """Async warm start must rescore the saved hall of fame against the new
+    dataset, on copies (same contract as lockstep/device; reference:
+    /root/reference/src/SymbolicRegression.jl:727-744)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 60)).astype(np.float32)
+    y = (2 * X[0]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*"],
+        populations=3,
+        population_size=12,
+        ncycles_per_iteration=20,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+        scheduler="async",
+    )
+    r1 = equation_search(X, y, options=opts, niterations=2, verbosity=0)
+    old_losses = {
+        id(m): m.loss for m in r1.hall_of_fame.members if m is not None
+    }
+    y2 = (-y + 10.0).astype(np.float32)
+    r2 = equation_search(
+        X, y2, options=opts, niterations=1, verbosity=0, saved_state=r1
+    )
+    for m in r2.hall_of_fame.members:
+        if m is None:
+            continue
+        pred = m.tree.eval_np(X.astype(np.float64), opts.operators)
+        true_loss = float(np.mean((pred - y2) ** 2))
+        assert m.loss == pytest.approx(true_loss, rel=1e-3, abs=1e-4)
+        # and no aliasing: r1's member objects were not mutated
+        assert id(m) not in old_losses
+    for m in r1.hall_of_fame.members:
+        if m is not None:
+            assert m.loss == old_losses[id(m)]

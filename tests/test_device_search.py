@@ -68,6 +68,32 @@ def test_device_search_warm_start():
     assert best2 <= best1 + 1e-6
 
 
+def test_device_search_warm_start_rescores_on_changed_dataset():
+    """Warm-starting against a DIFFERENT dataset must rescore the saved hall
+    of fame — stale losses from the old dataset may be impossibly good for
+    the new one (reference rescores on warm start,
+    /root/reference/src/SymbolicRegression.jl:727-744)."""
+    X, y = _problem()
+    r1 = equation_search(X, y, options=_opts(), niterations=2, verbosity=0)
+    # new target: y2 = -y + 10, so r1's winners fit terribly
+    y2 = (-y + 10.0).astype(np.float32)
+    r2 = equation_search(
+        X, y2, options=_opts(ncycles_per_iteration=1), niterations=1,
+        verbosity=0, saved_state=r1,
+    )
+    old_best = min(m.loss for m in r1.pareto_frontier)
+    # every member of the new hall of fame carries a loss computed against
+    # y2: the stale near-zero losses must NOT survive re-ingestion
+    for m in r2.hall_of_fame.members:
+        if m is None:
+            continue
+        pred = m.tree.eval_np(X.astype(np.float64), r2.options.operators)
+        true_loss = float(np.mean((pred - y2) ** 2))
+        assert m.loss == pytest.approx(true_loss, rel=1e-3, abs=1e-4)
+    assert min(m.loss for m in r2.pareto_frontier) >= 0.0
+    assert old_best < 1.5  # r1 actually fit the original target
+
+
 def test_device_mode_rejects_unsupported():
     X, y = _problem()
     opts = _opts(constraints={"*": (3, 3)})

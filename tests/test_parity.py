@@ -136,6 +136,38 @@ class TestDtypeSweep:
         losses = scorer.loss_many([t])
         assert np.asarray(losses).dtype == np.float64
 
+    def test_float64_resolution_survives_compute(self):
+        """A loss below f32 resolution must come back non-zero and accurate:
+        y = x0*(1+1e-10) vs the tree x0 gives loss ~1e-20, which f32 compute
+        would flush to 0 (or eps-garbage)."""
+        from symbolicregression_jl_tpu.models.scorer import BatchScorer
+        from symbolicregression_jl_tpu.dataset import Dataset
+        from symbolicregression_jl_tpu.tree import feature
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(1, 50))
+        y = X[0] * (1.0 + 1e-10)
+        opts = Options(
+            binary_operators=["+", "*"], save_to_file=False, dtype=np.float64
+        )
+        scorer = BatchScorer(Dataset(X, y), opts)
+        loss = float(np.asarray(scorer.loss_many([feature(0)]))[0])
+        expected = float(np.mean((X[0] - y) ** 2))
+        assert expected < 1e-18
+        assert loss == pytest.approx(expected, rel=1e-6)
+
+    def test_device_scheduler_rejects_float64(self):
+        """The device engine is f32-only and must say so, not truncate."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 40))
+        opts = Options(
+            binary_operators=["+", "*"], save_to_file=False,
+            dtype=np.float64, scheduler="device",
+        )
+        with pytest.raises(ValueError, match="non-float32"):
+            equation_search(X, X[0] * 2, options=opts, niterations=1,
+                            verbosity=0)
+
 
 def test_annealing_end_to_end():
     """annealing=True accept rule exercised through a full recovery
