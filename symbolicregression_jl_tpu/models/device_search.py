@@ -24,7 +24,9 @@ import numpy as np
 from ..dataset import Dataset
 from ..options import Options
 from ..ops.evolve import EvoConfig, EvoState, _score_of, init_state, run_iteration
-from ..ops.flat import KIND_CONST, FlatTrees, flatten_trees, unflatten_tree
+from ..ops.flat import (
+    KIND_CONST, FlatTrees, batch_bucket, flatten_trees, unflatten_tree,
+)
 from ..ops.treeops import Tree
 from .hall_of_fame import HallOfFame
 from .pop_member import PopMember
@@ -429,7 +431,8 @@ def device_search_one_output(
         jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
         jnp.asarray(flat.length),
     )
-    init_losses = jax.jit(score_fn)(batch0)
+    score_jit = jax.jit(score_fn)
+    init_losses = score_jit(batch0)
 
     seed = int(rng.integers(0, 2**31 - 1))
     state = init_state(flat, np.zeros(I * P), cfg, seed)
@@ -453,29 +456,55 @@ def device_search_one_output(
             if m is not None
         ]
         if saved_members:
-            sflat = flatten_trees([m.tree for m in saved_members], N)
+            # pad to a power-of-two bucket and reuse the init jit wrapper —
+            # one extra compile at most, per the shared batch_bucket policy
+            strees = [m.tree for m in saved_members]
+            pad = batch_bucket(len(strees)) - len(strees)
+            sflat = flatten_trees(strees + [strees[0]] * pad, N)
             sbatch = Tree(
                 jnp.asarray(sflat.kind), jnp.asarray(sflat.op),
                 jnp.asarray(sflat.lhs), jnp.asarray(sflat.rhs),
                 jnp.asarray(sflat.feat), jnp.asarray(sflat.val),
                 jnp.asarray(sflat.length),
             )
-            slosses = np.asarray(jax.jit(score_fn)(sbatch))
+            slosses = np.asarray(score_jit(sbatch))[: len(strees)]
             for m, loss in zip(saved_members, slosses):
                 comp = m.get_complexity(options)
                 m.loss = float(loss)
                 m.score = float(_score_of(float(loss), float(comp), cfg))
                 hof.update(m, options)
     early_stop = options.early_stop_fn()
+
+    # default jit warmup: AOT-compile the iteration/const-opt/readback
+    # programs (shapes are fixed for the whole search) so iteration 1 runs
+    # at steady-state speed (reference precompiles its workload,
+    # /root/reference/src/precompile.jl:36-93). lower().compile() builds
+    # the executable without running an iteration.
+    if options.jit_warmup:
+        run_step = run_iteration.lower(state, cfg, score_fn).compile()
+        copt_step = (
+            const_opt_fn.lower(state).compile()
+            if const_opt_fn is not None
+            else None
+        )
+        readback_step = readback_fn.lower(state).compile()
+    else:
+        run_step = lambda s: run_iteration(s, cfg, score_fn)  # noqa: E731
+        copt_step = const_opt_fn
+        readback_step = readback_fn
+
+    from ..utils.stdin_reader import StdinReader
+
+    stdin_reader = StdinReader()
     start_time = time.time()
     stop_reason = None
     num_evals = 0.0
 
     for it in range(niterations):
-        state = run_iteration(state, cfg, score_fn)
-        if const_opt_fn is not None:
-            state = const_opt_fn(state)
-        buf = np.asarray(readback_fn(state))  # the iteration's ONE readback
+        state = run_step(state)
+        if copt_step is not None:
+            state = copt_step(state)
+        buf = np.asarray(readback_step(state))  # the iteration's ONE readback
         bs_loss, bs_exists, bs_len, fields, num_evals = _decode_readback(buf, cfg)
         for m in _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg, options):
             hof.update(m, options)
@@ -509,6 +538,11 @@ def device_search_one_output(
         if options.max_evals is not None and num_evals >= options.max_evals:
             stop_reason = "max_evals"
             break
+        if stdin_reader.check_for_user_quit():
+            stop_reason = "user_quit"
+            break
+
+    stdin_reader.close()
 
     # --- final population readback (host Populations for warm starts) -------
     def np_at(a):

@@ -1,0 +1,53 @@
+"""Default jit warmup: compile the hot programs before the timed loop.
+
+The reference precompiles its full search workload at package build time
+(/root/reference/src/precompile.jl:36-93). XLA programs are specialized on
+array *shapes*, so the equivalent here is priming the scoring and
+constant-optimization programs at the exact candidate-batch buckets the
+first iteration will request — after this, iteration 1 runs at steady-state
+speed instead of absorbing every compile.
+
+Batch sizes are padded to power-of-two buckets (ops/flat.batch_bucket), so
+the set to prime is small and predictable:
+- evolve-cycle candidate batches: between I*e and 2*I*e trees, where
+  e = ceil(P / tournament_n) events per island (1 candidate per mutation,
+  2 per crossover event)
+- per-island init / rescore batches: P trees
+- iteration-boundary full rescores: I*P trees
+- the BFGS constant-opt batch: ~optimizer_probability * I * P trees
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops.flat import batch_bucket
+from ..tree import constant
+
+__all__ = ["warmup_host_programs"]
+
+
+def warmup_host_programs(scorer, options, rng: np.random.Generator) -> None:
+    # warmup must only affect speed: draw from a PRIVATE generator so the
+    # caller's search trajectory is identical with jit_warmup on or off
+    wrng = np.random.default_rng(0)
+    I, P = options.populations, options.population_size
+    e = -(-P // options.tournament_selection_n)
+    buckets = sorted(
+        {batch_bucket(c) for c in (I * e, 2 * I * e, P, I * P)}
+    )
+    saved_evals = scorer.num_evals
+    dummy = constant(1.0)
+    idxs: list = [None]
+    if options.batching:
+        idxs.append(scorer.batch_indices(wrng))
+    for b in buckets:
+        for idx in idxs:
+            scorer.loss_many([dummy] * b, idx=idx)
+    if options.should_optimize_constants and options.optimizer_probability > 0:
+        from ..ops.constant_opt import optimize_constants_batched
+
+        n = max(1, int(round(I * P * options.optimizer_probability)))
+        optimize_constants_batched([dummy] * n, scorer, options, wrng)
+    # warmup evals are not real search work: keep the throughput metric honest
+    scorer.num_evals = saved_evals

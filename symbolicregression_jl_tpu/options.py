@@ -173,6 +173,11 @@ class Options:
     #   fastest on TPU, subset of features (see device_mode_supported);
     # "async": reference-style async island scheduler (parallel/islands.py)
     scheduler: str = "lockstep"
+    # compile the scoring/const-opt/iteration programs before the timed
+    # loop so iteration 1 runs at steady-state speed (the reference
+    # precompiles its workload at package build,
+    # /root/reference/src/precompile.jl:36-93)
+    jit_warmup: bool = True
     data_sharding: str | None = None  # "rows" to shard dataset rows over devices
 
     # -- derived (filled in __post_init__) -----------------------------------

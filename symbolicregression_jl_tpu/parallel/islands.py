@@ -106,6 +106,13 @@ def async_search_one_output(
 
     lock = threading.Lock()  # guards hof / stats / pops / scorer counters
     early_stop = options.early_stop_fn()
+    if options.jit_warmup:
+        from ..models.warmup import warmup_host_programs
+
+        warmup_host_programs(scorer, options, rng)
+    from ..utils.stdin_reader import StdinReader
+
+    stdin_reader = StdinReader()
     start_time = time.time()
     stop_reason: list = [None]
     cycles_left = [niterations] * n_islands
@@ -189,6 +196,8 @@ def async_search_one_output(
                 stop_reason[0] = "timeout"
             if options.max_evals is not None and scorer.num_evals >= options.max_evals:
                 stop_reason[0] = "max_evals"
+            if stdin_reader.check_for_user_quit():
+                stop_reason[0] = "user_quit"
         # head-node occupancy (reference: ResourceMonitor + >40% warning,
         # /root/reference/src/SearchUtils.jl:217-284)
         reporter.head_work(time.time() - t_head)
@@ -221,6 +230,7 @@ def async_search_one_output(
                     on_complete(idx, pop, best_seen)
                 break
 
+    stdin_reader.close()
     recorder.dump()
     result = SearchResult(
         hall_of_fame=hof,

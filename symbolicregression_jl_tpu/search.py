@@ -151,6 +151,13 @@ def _search_one_output(
     stats = RunningSearchStatistics(options.maxsize)
     stats_list = [stats] * len(pops)  # shared: lockstep updates at barriers only
     early_stop = options.early_stop_fn()
+    if options.jit_warmup:
+        from .models.warmup import warmup_host_programs
+
+        warmup_host_programs(scorer, options, rng)
+    from .utils.stdin_reader import StdinReader
+
+    stdin_reader = StdinReader()
     start_time = time.time()
     stop_reason = None
     from .utils.progress import ProgressReporter
@@ -230,7 +237,11 @@ def _search_one_output(
         if options.max_evals is not None and scorer.num_evals >= options.max_evals:
             stop_reason = "max_evals"
             break
+        if stdin_reader.check_for_user_quit():
+            stop_reason = "user_quit"
+            break
 
+    stdin_reader.close()
     recorder.dump()
     result = SearchResult(
         hall_of_fame=hof,
@@ -378,6 +389,8 @@ def equation_search(
                     output_file=output_file,
                 )
             )
+            if getattr(results[-1], "stop_reason", None) == "user_quit":
+                break
             continue
         if options.scheduler == "device":
             from .models.device_search import device_search_one_output
@@ -393,6 +406,8 @@ def equation_search(
                     output_file=output_file,
                 )
             )
+            if getattr(results[-1], "stop_reason", None) == "user_quit":
+                break
             continue
         results.append(
             _search_one_output(
@@ -405,4 +420,8 @@ def equation_search(
                 output_file=output_file,
             )
         )
+        # 'q' quits the WHOLE search, not just the current output (reference:
+        # one watch_stream for the run, /root/reference/src/SearchUtils.jl:140-188)
+        if getattr(results[-1], "stop_reason", None) == "user_quit":
+            break
     return results if multi_output else results[0]
