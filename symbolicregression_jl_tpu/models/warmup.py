@@ -8,13 +8,16 @@ first iteration will request — after this, iteration 1 runs at steady-state
 speed instead of absorbing every compile.
 
 Batch sizes are padded to power-of-two buckets (ops/flat.batch_bucket), so
-the set to prime is small and predictable:
-- evolve-cycle candidate batches: between I*e and 2*I*e trees, where
-  e = ceil(P / tournament_n) events per island (1 candidate per mutation,
-  2 per crossover event)
-- per-island init / rescore batches: P trees
-- iteration-boundary full rescores: I*P trees
-- the BFGS constant-opt batch: ~optimizer_probability * I * P trees
+the set to prime is small, predictable, and scheduler-dependent:
+- lockstep batches all islands per cycle: I*e..2*I*e candidates (e =
+  ceil(P / tournament_n) events per island; 1 candidate per mutation, 2 per
+  crossover), P-tree island inits, I*P full rescores, and a
+  ~optimizer_probability * I * P BFGS batch
+- async runs each island separately: e..2*e candidates, P-tree inits, and
+  a ~optimizer_probability * P BFGS batch
+
+Warmup draws only from a PRIVATE generator — search trajectories are
+identical with jit_warmup on or off.
 """
 
 from __future__ import annotations
@@ -27,15 +30,17 @@ from ..tree import constant
 __all__ = ["warmup_host_programs"]
 
 
-def warmup_host_programs(scorer, options, rng: np.random.Generator) -> None:
-    # warmup must only affect speed: draw from a PRIVATE generator so the
-    # caller's search trajectory is identical with jit_warmup on or off
+def warmup_host_programs(scorer, options) -> None:
     wrng = np.random.default_rng(0)
     I, P = options.populations, options.population_size
     e = -(-P // options.tournament_selection_n)
-    buckets = sorted(
-        {batch_bucket(c) for c in (I * e, 2 * I * e, P, I * P)}
-    )
+    if options.scheduler == "async":
+        score_sizes = (e, 2 * e, P)
+        opt_n = max(1, int(round(P * options.optimizer_probability)))
+    else:
+        score_sizes = (I * e, 2 * I * e, P, I * P)
+        opt_n = max(1, int(round(I * P * options.optimizer_probability)))
+    buckets = sorted({batch_bucket(c) for c in score_sizes})
     saved_evals = scorer.num_evals
     dummy = constant(1.0)
     idxs: list = [None]
@@ -47,7 +52,6 @@ def warmup_host_programs(scorer, options, rng: np.random.Generator) -> None:
     if options.should_optimize_constants and options.optimizer_probability > 0:
         from ..ops.constant_opt import optimize_constants_batched
 
-        n = max(1, int(round(I * P * options.optimizer_probability)))
-        optimize_constants_batched([dummy] * n, scorer, options, wrng)
+        optimize_constants_batched([dummy] * opt_n, scorer, options, wrng)
     # warmup evals are not real search work: keep the throughput metric honest
     scorer.num_evals = saved_evals

@@ -626,3 +626,298 @@ def pallas_supported(opset: OperatorSet, n_features: int = 2, loss_elem=None) ->
         warnings.warn(f"Pallas eval unavailable for {opset}: {type(e).__name__}: {e}")
         _SUPPORT_CACHE[key] = False
     return _SUPPORT_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# Fused loss + d(loss)/d(constants) kernel: the constant-optimization fast
+# path (round-3 priority). One pass computes the forward values into VMEM,
+# then a reverse-postorder adjoint sweep over the SAME resident values —
+# replacing jax.grad through the scan interpreter (which re-materializes
+# every branch and capped the BFGS batch at chunk=8 with remat).
+#
+# Adjoint algebra: every node in a tree has exactly one parent, so the
+# adjoint buffer needs neither zero-init nor accumulation — the parent
+# WRITES each child's adjoint before the reverse sweep reaches the child
+# (reverse slot order visits parents first; the root's adjoint is the loss
+# cotangent w * dl/dpred). A constant slot's gradient is the row-sum of its
+# adjoint (the constant broadcasts across rows). Per-operator derivatives
+# come from jax.vjp of the same Mosaic-safe kernel lambdas the forward uses.
+#
+# The gradient output block is c_tile lanes wide (only the first n_slots
+# lanes carry data) because this backend aborts when kernels with different
+# vector lane widths share a process (see note on eval_trees_pallas).
+# ---------------------------------------------------------------------------
+
+
+def _make_loss_grad_kernel(
+    opset: OperatorSet, loss_elem, n_slots: int, p_tile: int, c_tile: int, C: int, R: int
+):
+    unary_fns = [op.kernel_fn or op.fn for op in opset.unary]
+    binary_fns = [op.kernel_fn or op.fn for op in opset.binary]
+    N = n_slots
+
+    def kernel(
+        ints_hbm, vals_hbm, x_ref, y_ref, w_ref,
+        out_ref, grad_ref, ints_s, vals_s, buf_ref, adj_ref, sems,
+    ):
+        p = pl.program_id(0)
+        t = pl.program_id(1)
+        start = p * p_tile
+
+        @pl.when(t == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+            grad_ref[...] = jnp.zeros_like(grad_ref)
+            c1 = pltpu.make_async_copy(
+                ints_hbm.at[pl.ds(start, p_tile), :], ints_s, sems.at[0]
+            )
+            c2 = pltpu.make_async_copy(
+                vals_hbm.at[pl.ds(start, p_tile), :], vals_s, sems.at[1]
+            )
+            c1.start()
+            c2.start()
+            c1.wait()
+            c2.wait()
+
+        yv = y_ref[...]  # (8, c_tile)
+        wv = w_ref[...]
+        sub = lax.broadcasted_iota(jnp.int32, (8, c_tile), 0)
+        col = lax.broadcasted_iota(jnp.int32, (8, c_tile), 1)
+        mask = sub * C + t * c_tile + col < R
+        wm = jnp.where(mask, wv, 0.0)
+        lane = lax.broadcasted_iota(jnp.int32, (1, c_tile), 1)
+
+        def tree_body(ti, _):
+            length = ints_s[ti, 4 * N]
+
+            # ---- forward sweep (identical to the fused loss kernel) --------
+            def slot_body(i, _):
+                code = ints_s[ti, i]
+                li = ints_s[ti, N + i]
+                ri = ints_s[ti, 2 * N + i]
+                i8 = pl.multiple_of(i * 8, 8)
+
+                @pl.when(code == 0)
+                def _const():
+                    buf_ref[pl.ds(i8, 8), :] = jnp.full(
+                        (8, c_tile), vals_s[ti, i], dtype=jnp.float32
+                    )
+
+                @pl.when(code == 1)
+                def _var():
+                    f8 = pl.multiple_of(ints_s[ti, 3 * N + i] * 8, 8)
+                    buf_ref[pl.ds(i8, 8), :] = x_ref[pl.ds(f8, 8), :]
+
+                for k, fn in enumerate(unary_fns):
+
+                    @pl.when(code == 2 + k)
+                    def _una(fn=fn):
+                        l8 = pl.multiple_of(li * 8, 8)
+                        buf_ref[pl.ds(i8, 8), :] = fn(buf_ref[pl.ds(l8, 8), :])
+
+                for k, fn in enumerate(binary_fns):
+
+                    @pl.when(code == 2 + len(unary_fns) + k)
+                    def _bin(fn=fn):
+                        l8 = pl.multiple_of(li * 8, 8)
+                        r8 = pl.multiple_of(ri * 8, 8)
+                        buf_ref[pl.ds(i8, 8), :] = fn(
+                            buf_ref[pl.ds(l8, 8), :], buf_ref[pl.ds(r8, 8), :]
+                        )
+
+                return 0
+
+            lax.fori_loop(0, length, slot_body, 0, unroll=False)
+
+            root8 = pl.multiple_of((length - 1) * 8, 8)
+            pred = buf_ref[pl.ds(root8, 8), :]
+            elem = loss_elem(pred, yv)
+            loss_part = jnp.sum(jnp.where(mask, elem * wv, 0.0))
+            wsum_part = jnp.sum(wm)
+            nonfin_part = jnp.sum(jnp.where(mask & ~jnp.isfinite(pred), 1.0, 0.0))
+            row = (
+                jnp.where(lane == 0, loss_part, 0.0)
+                + jnp.where(lane == 1, wsum_part, 0.0)
+                + jnp.where(lane == 2, nonfin_part, 0.0)
+            )
+            out_ref[pl.ds(ti, 1), :] = out_ref[pl.ds(ti, 1), :] + row
+
+            # ---- reverse adjoint sweep ------------------------------------
+            _, loss_vjp = jax.vjp(lambda pr: loss_elem(pr, yv), pred)
+            (ct,) = loss_vjp(wm)
+            adj_ref[pl.ds(root8, 8), :] = ct
+
+            def rev_body(j, _):
+                i = length - 1 - j
+                code = ints_s[ti, i]
+                li = ints_s[ti, N + i]
+                ri = ints_s[ti, 2 * N + i]
+                i8 = pl.multiple_of(i * 8, 8)
+                adj_i = adj_ref[pl.ds(i8, 8), :]
+
+                @pl.when(code == 0)
+                def _const_g():
+                    gval = jnp.sum(adj_i)
+                    grad_ref[pl.ds(ti, 1), :] = grad_ref[
+                        pl.ds(ti, 1), :
+                    ] + jnp.where(lane == i, gval, 0.0)
+
+                for k, fn in enumerate(unary_fns):
+
+                    @pl.when(code == 2 + k)
+                    def _una_b(fn=fn):
+                        l8 = pl.multiple_of(li * 8, 8)
+                        _, fvjp = jax.vjp(fn, buf_ref[pl.ds(l8, 8), :])
+                        (dl,) = fvjp(adj_i)
+                        adj_ref[pl.ds(l8, 8), :] = dl
+
+                for k, fn in enumerate(binary_fns):
+
+                    @pl.when(code == 2 + len(unary_fns) + k)
+                    def _bin_b(fn=fn):
+                        l8 = pl.multiple_of(li * 8, 8)
+                        r8 = pl.multiple_of(ri * 8, 8)
+                        _, fvjp = jax.vjp(
+                            fn, buf_ref[pl.ds(l8, 8), :], buf_ref[pl.ds(r8, 8), :]
+                        )
+                        dl, dr = fvjp(adj_i)
+                        adj_ref[pl.ds(l8, 8), :] = dl
+                        adj_ref[pl.ds(r8, 8), :] = dr
+
+                return 0
+
+            lax.fori_loop(0, length, rev_body, 0, unroll=False)
+            return 0
+
+        lax.fori_loop(0, p_tile, tree_body, 0)
+
+    kernel.__name__ = (
+        f"sr_lossgrad_n{n_slots}_p{p_tile}_c{c_tile}_C{C}_R{R}"
+        f"_h{hash(opset) & 0xFFFFFFFF:x}_l{_loss_uid(loss_elem)}"
+    )
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("opset", "loss_elem", "n_slots", "p_tile", "c_tile", "C", "R"),
+)
+def _loss_grad_pallas(
+    ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile, C, R
+):
+    """Returns (losses [P], grads [P, n_slots]): weighted-mean loss and its
+    gradient w.r.t. every val slot (nonzero only on constant slots)."""
+    P = ints.shape[0]
+    F = Xr.shape[0] // 8
+    n_c_tiles = C // c_tile
+    L = ints.shape[1]
+    Lv = vals.shape[1]
+    kernel = _name_with_P(
+        _make_loss_grad_kernel(opset, loss_elem, n_slots, p_tile, c_tile, C, R), P
+    )
+
+    out, grad = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((P, c_tile), jnp.float32),
+            jax.ShapeDtypeStruct((P, c_tile), jnp.float32),
+        ),
+        grid=(P // p_tile, n_c_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),  # ints (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),  # vals (HBM)
+            pl.BlockSpec(
+                (F * 8, c_tile), lambda p, t: (0, t), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((8, c_tile), lambda p, t: (0, t), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, c_tile), lambda p, t: (0, t), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (p_tile, c_tile), lambda p, t: (p, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (p_tile, c_tile), lambda p, t: (p, 0), memory_space=pltpu.VMEM
+            ),
+        ),
+        scratch_shapes=[
+            pltpu.SMEM((p_tile, L), jnp.int32),
+            pltpu.SMEM((p_tile, Lv), jnp.float32),
+            pltpu.VMEM((n_slots * 8, c_tile), jnp.float32),
+            pltpu.VMEM((n_slots * 8, c_tile), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+    )(ints, vals, Xr, yr, wr)
+
+    loss_sum, w_sum, nonfin = out[:, 0], out[:, 1], out[:, 2]
+    ok = (nonfin == 0) & (w_sum > 0)
+    denom = jnp.maximum(w_sum, 1e-30)
+    losses = jnp.where(ok, loss_sum / denom, jnp.inf)
+    grads = jnp.where(ok[:, None], grad[:, :n_slots] / denom[:, None], 0.0)
+    return losses, grads
+
+
+def make_pallas_loss_grad_fn(X, y, weights, opset: OperatorSet, loss_elem):
+    """Build the const-opt fast path: dataset resident in sublane layout,
+    returns ``fn(ints [B, L], vals [B, N]) -> (losses [B], grads [B, N])``.
+    Gradient convention matches jax.grad through the scan interpreter's loss
+    (weighted normalized mean, inf/zero-grad on non-finite predictions)."""
+    Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+
+    def fn(ints, vals, n_slots: int):
+        B = ints.shape[0]
+        if B % P_TILE_LOSS != 0:
+            raise ValueError(f"B={B} must be a multiple of {P_TILE_LOSS}")
+        Lv = _round_up(n_slots, 128)
+        vpad = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, Lv - n_slots)))
+        return _loss_grad_pallas(
+            ints, vpad, Xr, yr, wr, opset, loss_elem, n_slots,
+            P_TILE_LOSS, C_TILE, C, R,
+        )
+
+    return fn
+
+
+def pallas_grad_supported(
+    opset: OperatorSet, n_features: int = 2, loss_elem=None
+) -> bool:
+    """Probe-compile the loss+grad kernel (per-operator jax.vjp lambdas must
+    also lower through Mosaic). Cached per (opset, loss)."""
+    from .losses import L2DistLoss
+
+    loss_elem = loss_elem or L2DistLoss
+    if jax.devices()[0].platform == "cpu":
+        return False
+    key = ("grad", opset, loss_elem)
+    if key in _SUPPORT_CACHE:
+        return _SUPPORT_CACHE[key]
+    try:
+        from ..tree import binary, constant, feature, unary as unary_node
+        from .flat import flatten_trees
+
+        t = constant(1.0)
+        for i in range(opset.n_binary):
+            t = binary(i, t, feature(0))
+        for i in range(opset.n_unary):
+            t = unary_node(i, t)
+        n_nodes = 1 + 2 * opset.n_binary + opset.n_unary
+        flat = flatten_trees([t] * P_TILE_LOSS, _round_up(n_nodes, 8))
+        X = np.ones((max(n_features, 1), 128), np.float32)
+        y = np.ones((128,), np.float32)
+        fn = make_pallas_loss_grad_fn(X, y, None, opset, loss_elem)
+        ints, _ = pack_flat_fused(flat, opset)
+        losses, grads = fn(ints, jnp.asarray(flat.val), flat.kind.shape[1])
+        losses.block_until_ready()
+        grads.block_until_ready()
+        _SUPPORT_CACHE[key] = True
+    except Exception as e:  # noqa: BLE001 — any lowering failure means fallback
+        import warnings
+
+        warnings.warn(
+            f"Pallas loss+grad unavailable for {opset}: {type(e).__name__}: {e}"
+        )
+        _SUPPORT_CACHE[key] = False
+    return _SUPPORT_CACHE[key]

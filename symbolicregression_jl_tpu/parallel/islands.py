@@ -109,7 +109,7 @@ def async_search_one_output(
     if options.jit_warmup:
         from ..models.warmup import warmup_host_programs
 
-        warmup_host_programs(scorer, options, rng)
+        warmup_host_programs(scorer, options)
     from ..utils.stdin_reader import StdinReader
 
     stdin_reader = StdinReader()

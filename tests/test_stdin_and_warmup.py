@@ -156,8 +156,9 @@ def test_first_iteration_not_dominated_by_compiles():
     deltas = [times[0]] + [b - a for a, b in zip(times, times[1:])]
     steady = sorted(deltas[1:])[len(deltas[1:]) // 2]  # median of later iters
     # without warmup the first iteration carries ~seconds of XLA compiles
-    # and is >10x the steady state; with warmup it must be comparable
-    assert deltas[0] <= max(3.0 * steady, steady + 0.75), deltas
+    # and is >10x the steady state; with warmup it must be comparable. The
+    # generous absolute margin keeps a loaded CI host from false-failing.
+    assert deltas[0] <= max(3.0 * steady, steady + 2.0), deltas
 
 
 def test_jit_warmup_can_be_disabled():
