@@ -17,6 +17,7 @@ readbacks are packed into a single f32 array.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import numpy as np
@@ -32,7 +33,7 @@ from .hall_of_fame import HallOfFame
 from .pop_member import PopMember
 from .population import Population
 
-__all__ = ["device_search_one_output", "device_mode_supported"]
+__all__ = ["device_search_one_output", "device_mode_supported", "build_evo_config"]
 
 
 def device_mode_supported(options: Options, dataset: Dataset | None = None) -> str | None:
@@ -60,6 +61,66 @@ def device_mode_supported(options: Options, dataset: Dataset | None = None) -> s
     if np.dtype(options.dtype) != np.float32:
         return "non-float32 compute dtype"
     return None
+
+
+def build_evo_config(
+    options: Options,
+    n_features: int,
+    baseline_loss: float,
+    use_baseline: bool,
+    niterations: int,
+    n_islands: int | None = None,
+) -> EvoConfig:
+    """Translate Options into the device engine's static EvoConfig.
+    ``n_islands`` overrides options.populations (per-shard configs in the
+    multi-device/multi-host paths)."""
+    I = options.populations if n_islands is None else n_islands
+    P = options.population_size
+    mw = options.mutation_weights
+    tn = min(options.tournament_selection_n, P)
+    tw = np.asarray(options.tournament_weights)[:tn]
+    return EvoConfig(
+        n_islands=I,
+        pop_size=P,
+        n_slots=options.max_nodes,
+        maxsize=options.maxsize,
+        maxdepth=options.maxdepth,
+        nfeatures=n_features,
+        n_unary=options.operators.n_unary,
+        n_binary=options.operators.n_binary,
+        tournament_n=tn,
+        tournament_weights=tuple(tw / tw.sum()),
+        mutation_weights=(
+            mw.mutate_constant,
+            mw.mutate_operator,
+            mw.swap_operands,
+            mw.add_node,
+            mw.insert_node,
+            mw.delete_node,
+            mw.randomize,
+            mw.do_nothing,
+        ),
+        crossover_probability=options.crossover_probability,
+        annealing=options.annealing,
+        alpha=options.alpha,
+        parsimony=options.parsimony,
+        use_frequency=options.use_frequency,
+        use_frequency_in_tournament=options.use_frequency_in_tournament,
+        adaptive_parsimony_scaling=options.adaptive_parsimony_scaling,
+        perturbation_factor=options.perturbation_factor,
+        probability_negate_constant=options.probability_negate_constant,
+        baseline_loss=baseline_loss,
+        use_baseline=use_baseline,
+        ncycles=options.ncycles_per_iteration,
+        events_per_cycle=max(1, -(-P // tn)),
+        fraction_replaced=options.fraction_replaced,
+        fraction_replaced_hof=options.fraction_replaced_hof,
+        migration=options.migration,
+        hof_migration=options.hof_migration,
+        topn=min(options.topn, P),
+        niterations=niterations,
+        warmup_maxsize_by=options.warmup_maxsize_by,
+    )
 
 
 def _make_score_fn(X, y, weights, options: Options, use_pallas: bool):
@@ -130,12 +191,17 @@ def _make_score_fn(X, y, weights, options: Options, use_pallas: bool):
     return score_fn
 
 
-def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig):
+def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig, axis=None):
     """Jitted per-iteration constant optimization over a fixed-size random
     member subset, fully device-side (selection, BFGS, accept, scatter-back).
     Reference semantics: optimize with prob optimizer_probability per member,
     accept if improved, reset birth
-    (/root/reference/src/ConstantOptimization.jl:11-83)."""
+    (/root/reference/src/ConstantOptimization.jl:11-83).
+
+    ``axis``: island-sharded shard_map mode — ``cfg`` is then the PER-SHARD
+    config (local island count) and each shard optimizes its own K members;
+    see _select_and_jitter for the key discipline. Returns the UNJITTED impl
+    in that mode (the caller wraps it in shard_map + jit)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -178,9 +244,10 @@ def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig):
     def loss_fn(v, s, X_, y_, w_, hw_):
         return _ck(v, s)
 
-    @jax.jit
     def const_opt(state: EvoState) -> EvoState:
-        key, ii, pp, val0, mask, starts = _select_and_jitter(state, K, S, I, P)
+        key, ii, pp, val0, mask, starts = _select_and_jitter(
+            state, K, S, I, P, axis=axis
+        )
 
         def field(a):
             return a[ii, pp]
@@ -212,20 +279,30 @@ def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig):
         vals = vals.reshape((K,) + vals.shape[2:])
         fs = fs.reshape((K,))
         return _accept_and_scatter(
-            state, cfg, key, ii, pp, mask, val0, vals, fs, K * S * 2 * iters
+            state, cfg, key, ii, pp, mask, val0, vals, fs, K * S * 2 * iters,
+            axis=axis,
         )
 
-    return const_opt
+    return const_opt if axis is not None else jax.jit(const_opt)
 
 
-def _select_and_jitter(state: EvoState, K: int, S: int, I: int, P: int):
+def _select_and_jitter(state: EvoState, K: int, S: int, I: int, P: int, axis=None):
     """Shared const-opt front half: pick K distinct member slots and build
     the x(1 + 0.5*randn) restart starts [K, S, N] (reference's perturbed
-    re-starts, /root/reference/src/ConstantOptimization.jl:53-68)."""
+    re-starts, /root/reference/src/ConstantOptimization.jl:53-68).
+
+    ``axis``: shard_map mode — each shard folds its axis index into the
+    (replicated) key so shards pick different members; the key returned here
+    is shard-divergent and _accept_and_scatter re-replicates it."""
     import jax
     import jax.numpy as jnp
 
-    key, k_sel, k_jit = jax.random.split(state.key, 3)
+    base_key = state.key
+    if axis is not None:
+        from jax import lax
+
+        base_key = jax.random.fold_in(base_key, lax.axis_index(axis))
+    key, k_sel, k_jit = jax.random.split(base_key, 3)
     flat_idx = jax.random.permutation(k_sel, I * P)[:K]
     ii, pp = flat_idx // P, flat_idx % P
     kind = state.kind[ii, pp]
@@ -239,12 +316,24 @@ def _select_and_jitter(state: EvoState, K: int, S: int, I: int, P: int):
 
 def _accept_and_scatter(
     state: EvoState, cfg: EvoConfig, key, ii, pp, mask_k, val0, vals, fbest,
-    n_evals: int,
+    n_evals: int, axis=None,
 ):
     """Shared const-opt back half: accept only improvements, scatter new
     constants/losses/scores back, reset birth (reference accept rule,
-    /root/reference/src/ConstantOptimization.jl:70-78)."""
+    /root/reference/src/ConstantOptimization.jl:70-78).
+
+    ``axis``: shard_map mode — n_evals counts one shard's work so the
+    replicated counter advances by the psum; the stored key is re-derived
+    from the replicated entry key (the passed one is shard-divergent)."""
     import jax.numpy as jnp
+
+    n_evals = jnp.asarray(n_evals, jnp.float32)
+    if axis is not None:
+        import jax
+        from jax import lax
+
+        n_evals = lax.psum(n_evals, axis)
+        key = jax.random.fold_in(state.key, 0x0C07)
 
     old_loss = state.loss[ii, pp]
     has_consts = jnp.any(mask_k, axis=1)
@@ -265,7 +354,7 @@ def _accept_and_scatter(
     )
 
 
-def _make_const_opt_fn_pallas(X, y, weights, options: Options, cfg: EvoConfig):
+def _make_const_opt_fn_pallas(X, y, weights, options: Options, cfg: EvoConfig, axis=None):
     """Constant optimization through the fused Pallas loss+grad kernel
     (ops/interp_pallas._loss_grad_pallas): the whole (member, restart) batch
     runs one BFGS in lockstep, with gradients from the in-VMEM reverse
@@ -302,9 +391,10 @@ def _make_const_opt_fn_pallas(X, y, weights, options: Options, cfg: EvoConfig):
     grad_fn = make_pallas_loss_grad_fn(X, y, weights, opset, loss_elem)
     loss_fn = make_packed_loss_fn(X, y, weights, opset, loss_elem, N)
 
-    @jax.jit
     def const_opt(state: EvoState) -> EvoState:
-        key, ii, pp, val0, mask_k, starts = _select_and_jitter(state, K, S, I, P)
+        key, ii, pp, val0, mask_k, starts = _select_and_jitter(
+            state, K, S, I, P, axis=axis
+        )
         starts = starts.reshape(K * S, N)
 
         def field(a):
@@ -400,10 +490,25 @@ def _make_const_opt_fn_pallas(X, y, weights, options: Options, cfg: EvoConfig):
         fbest = jnp.take_along_axis(fs, best[:, None], axis=1)[:, 0]
         return _accept_and_scatter(
             state, cfg, key, ii, pp, mask_k, val0, vals, fbest,
-            K * S * 2 * iters,
+            K * S * 2 * iters, axis=axis,
         )
 
-    return const_opt
+    return const_opt if axis is not None else jax.jit(const_opt)
+
+
+def _shard_const_opt(mesh, impl):
+    """Wrap an axis-mode const-opt impl in shard_map over the 'pop' axis."""
+    import jax
+
+    from ..ops.evolve import evo_state_specs
+
+    specs = evo_state_specs()
+    return jax.jit(
+        jax.shard_map(
+            impl, mesh=mesh, in_specs=(specs,), out_specs=specs,
+            check_vma=False,
+        )
+    )
 
 
 def _make_readback_fn(cfg: EvoConfig):
@@ -512,52 +617,27 @@ def device_search_one_output(
     dataset.baseline_loss = bl if use_baseline else 1.0
     dataset.use_baseline = use_baseline
 
-    mw = options.mutation_weights
-    cfg = EvoConfig(
-        n_islands=I,
-        pop_size=P,
-        n_slots=N,
-        maxsize=options.maxsize,
-        maxdepth=options.maxdepth,
-        nfeatures=dataset.n_features,
-        n_unary=options.operators.n_unary,
-        n_binary=options.operators.n_binary,
-        tournament_n=min(options.tournament_selection_n, P),
-        tournament_weights=tuple(
-            np.asarray(options.tournament_weights)[: min(options.tournament_selection_n, P)]
-            / np.asarray(options.tournament_weights)[: min(options.tournament_selection_n, P)].sum()
-        ),
-        mutation_weights=(
-            mw.mutate_constant,
-            mw.mutate_operator,
-            mw.swap_operands,
-            mw.add_node,
-            mw.insert_node,
-            mw.delete_node,
-            mw.randomize,
-            mw.do_nothing,
-        ),
-        crossover_probability=options.crossover_probability,
-        annealing=options.annealing,
-        alpha=options.alpha,
-        parsimony=options.parsimony,
-        use_frequency=options.use_frequency,
-        use_frequency_in_tournament=options.use_frequency_in_tournament,
-        adaptive_parsimony_scaling=options.adaptive_parsimony_scaling,
-        perturbation_factor=options.perturbation_factor,
-        probability_negate_constant=options.probability_negate_constant,
+    cfg = build_evo_config(
+        options,
+        n_features=dataset.n_features,
         baseline_loss=dataset.baseline_loss,
         use_baseline=use_baseline,
-        ncycles=options.ncycles_per_iteration,
-        events_per_cycle=max(1, -(-P // min(options.tournament_selection_n, P))),
-        fraction_replaced=options.fraction_replaced,
-        fraction_replaced_hof=options.fraction_replaced_hof,
-        migration=options.migration,
-        hof_migration=options.hof_migration,
-        topn=min(options.topn, P),
         niterations=niterations,
-        warmup_maxsize_by=options.warmup_maxsize_by,
     )
+
+    # --- multi-device: shard the island axis over a 'pop' mesh --------------
+    # Each device owns I/n_dev islands; per-cycle cross-device traffic is the
+    # frequency-delta psum + best-seen merge (ops/evolve.py). Within-device
+    # migration uses the local topn pool; cross-device mixing rides the
+    # globally-merged best-seen frontier (hof_migration).
+    n_dev = len(jax.devices())
+    mesh = None
+    cfg_local = cfg
+    if n_dev > 1 and I % n_dev == 0:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_dev, 1)
+        cfg_local = dataclasses.replace(cfg, n_islands=I // n_dev)
 
     use_pallas = jax.devices()[0].platform != "cpu"
     if use_pallas:
@@ -576,11 +656,15 @@ def device_search_one_output(
             use_pallas_grad = pallas_grad_supported(
                 options.operators, dataset.n_features, options.loss
             )
-        const_opt_fn = (
-            _make_const_opt_fn_pallas(X, y, w, options, cfg)
-            if use_pallas_grad
-            else _make_const_opt_fn(X, y, w, options, cfg)
+        make_copt = (
+            _make_const_opt_fn_pallas if use_pallas_grad else _make_const_opt_fn
         )
+        if mesh is not None:
+            const_opt_fn = _shard_const_opt(
+                mesh, make_copt(X, y, w, options, cfg_local, axis="pop")
+            )
+        else:
+            const_opt_fn = make_copt(X, y, w, options, cfg)
     readback_fn = _make_readback_fn(cfg)
 
     # --- initial populations (host trees -> device state) -------------------
@@ -614,6 +698,14 @@ def device_search_one_output(
     comp = state.length.astype(jnp.float32)
     loss_dev = init_losses.reshape(I, P)
     state = state._replace(loss=loss_dev, score=_score_of(loss_dev, comp, cfg))
+
+    if mesh is not None:
+        from ..ops.evolve import make_sharded_iteration, shard_evo_state
+
+        state = shard_evo_state(state, mesh)
+        iter_fn = make_sharded_iteration(mesh, cfg_local, score_fn)
+    else:
+        iter_fn = None
 
     hof = HallOfFame(options.maxsize)
     if saved_state is not None:
@@ -654,7 +746,11 @@ def device_search_one_output(
     # /root/reference/src/precompile.jl:36-93). lower().compile() builds
     # the executable without running an iteration.
     if options.jit_warmup:
-        run_step = run_iteration.lower(state, cfg, score_fn).compile()
+        run_step = (
+            iter_fn.lower(state).compile()
+            if iter_fn is not None
+            else run_iteration.lower(state, cfg, score_fn).compile()
+        )
         copt_step = (
             const_opt_fn.lower(state).compile()
             if const_opt_fn is not None
@@ -662,7 +758,11 @@ def device_search_one_output(
         )
         readback_step = readback_fn.lower(state).compile()
     else:
-        run_step = lambda s: run_iteration(s, cfg, score_fn)  # noqa: E731
+        run_step = (
+            iter_fn
+            if iter_fn is not None
+            else lambda s: run_iteration(s, cfg, score_fn)
+        )
         copt_step = const_opt_fn
         readback_step = readback_fn
 

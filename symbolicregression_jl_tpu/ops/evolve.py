@@ -55,7 +55,15 @@ from .treeops import (
     tree_depth,
 )
 
-__all__ = ["EvoConfig", "EvoState", "init_state", "run_iteration"]
+__all__ = [
+    "EvoConfig",
+    "EvoState",
+    "init_state",
+    "run_iteration",
+    "evo_state_specs",
+    "shard_evo_state",
+    "make_sharded_iteration",
+]
 
 
 # Mutation kind indices for the device switch (subset of the reference's 12;
@@ -525,7 +533,7 @@ def _apply_mutation(
 # ---------------------------------------------------------------------------
 
 
-def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
+def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, axis=None):
     """One full evolve pass: ALL of a cycle's events for ALL islands in one
     batched step. The reference runs a pass's events sequentially
     (/root/reference/src/RegularizedEvolution.jl:31-33); batching them against
@@ -534,7 +542,13 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     dispatch count. Tournament -> mutate or crossover -> score -> Metropolis
     accept -> ALWAYS replace: event lane e replaces the (2e)-th oldest member
     (the reference replaces the oldest even on rejection — the baby is then a
-    parent copy; :33-105) and a crossover's second child the (2e+1)-th."""
+    parent copy; :33-105) and a crossover's second child the (2e+1)-th.
+
+    ``axis``: when run inside shard_map with the island axis sharded over a
+    mesh axis of that name, the two cross-island structures stay lockstep via
+    explicit collectives — the frequency histogram merges with a psum of the
+    per-shard delta, and the best-seen frontier merges with a pmin + owner
+    broadcast. Everything else is island-local and needs no communication."""
     I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
     E = min(cfg.events_per_cycle, P)  # host parity: ceil(P/tournament_n) <= P
     L = I * E  # event lanes
@@ -700,13 +714,16 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     st = insert(state, slot1, baby1, bloss1, bscore1, jnp.ones((L,), bool))
     st = insert(st, slot2, baby2, bloss2, bscore2, do_xover)
 
-    # --- frequency histogram (accepted inserts) ------------------------------
-    freq = st.freq.at[jnp.clip(baby1.length, 0, cfg.maxsize)].add(
+    # --- frequency histogram (accepted inserts); cross-shard: psum the delta -
+    fd = jnp.zeros_like(st.freq).at[jnp.clip(baby1.length, 0, cfg.maxsize)].add(
         jnp.where(accept1, 1.0, 0.0)
     )
-    freq = freq.at[jnp.clip(baby2.length, 0, cfg.maxsize)].add(
+    fd = fd.at[jnp.clip(baby2.length, 0, cfg.maxsize)].add(
         jnp.where(accept2, 1.0, 0.0)
     )
+    if axis is not None:
+        fd = lax.psum(fd, axis)
+    freq = st.freq + fd
 
     # --- best-seen per complexity (the per-cycle mini hall of fame,
     # /root/reference/src/SingleIteration.jl:64-100). Deterministic per-size
@@ -722,17 +739,37 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
     cand_loss = jnp.where(size_mask & all_valid[None, :], all_loss[None, :], jnp.inf)
     best_idx = jnp.argmin(cand_loss, axis=1)  # [S1]
     best_loss_s = jnp.min(cand_loss, axis=1)
+    tree_fields = [batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat, batch.val]
+    cand_fields = [field[best_idx] for field in tree_fields]  # [S1, N]
+    cand_len = batch.length[best_idx]
+    if axis is not None:
+        # merge per-shard candidates: global min loss per size, then the
+        # lowest-indexed winning shard broadcasts its tree via a masked psum
+        g_loss = lax.pmin(best_loss_s, axis)
+        idx = lax.axis_index(axis)
+        win = (best_loss_s <= g_loss) & jnp.isfinite(g_loss)
+        owner = lax.pmin(
+            jnp.where(win, idx, jnp.iinfo(jnp.int32).max), axis
+        )
+        mine = win & (idx == owner)
+        cand_fields = [
+            lax.psum(jnp.where(mine[:, None], f, jnp.zeros_like(f)), axis)
+            for f in cand_fields
+        ]
+        cand_len = lax.psum(jnp.where(mine, cand_len, 0), axis)
+        best_loss_s = g_loss
     better = best_loss_s < st.bs_loss
     bs_loss = jnp.where(better, best_loss_s, st.bs_loss)
-    tree_fields = [batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat, batch.val]
     bt_new = [
-        jnp.where(better[:, None], field[best_idx], cur)
-        for cur, field in zip(st.bs_tree[:6], tree_fields)
+        jnp.where(better[:, None], f, cur)
+        for cur, f in zip(st.bs_tree[:6], cand_fields)
     ]
-    bs_len = jnp.where(better, batch.length[best_idx], st.bs_tree[6])
+    bs_len = jnp.where(better, cand_len, st.bs_tree[6])
     bs_exists = st.bs_exists | better
 
     n_scored = L + jnp.sum(do_xover)
+    if axis is not None:
+        n_scored = lax.psum(n_scored, axis)
     return st._replace(
         freq=freq,
         bs_loss=bs_loss,
@@ -749,8 +786,9 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))
-def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
+def _run_iteration_impl(
+    state: EvoState, cfg: EvoConfig, score_fn, axis=None
+) -> EvoState:
     """Advance every island through one full iteration (the reference's
     _dispatch_s_r_cycle, /root/reference/src/SymbolicRegression.jl:1088-1129):
     ncycles of regularized evolution with annealed temperature, then
@@ -759,7 +797,16 @@ def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
 
     NOTE every argument is a device array or static — post-first-readback this
     backend charges ~100ms fixed per host-to-device transfer, so even scalars
-    (curmaxsize) are computed ON DEVICE from state.iteration."""
+    (curmaxsize) are computed ON DEVICE from state.iteration.
+
+    ``axis``: shard_map island-axis mode (see _event). The PRNG key stays
+    replicated across shards: each shard folds in its axis index for its own
+    draws, and the replicated key advances by the same fold on every shard."""
+    key_in = state.key
+    if axis is not None:
+        state = state._replace(
+            key=jax.random.fold_in(key_in, lax.axis_index(axis))
+        )
     total = cfg.ncycles  # one batched _event per cycle (all events at once)
 
     # warmup-maxsize schedule (get_cur_maxsize,
@@ -778,7 +825,7 @@ def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
         # (host parity: models/single_iteration.py np.linspace(1.0, 0.0, n))
         frac = cycle.astype(jnp.float32) / max(cfg.ncycles - 1, 1)
         temperature = 1.0 - frac if cfg.annealing else jnp.asarray(1.0)
-        return _event(st, cfg, score_fn, temperature, curmaxsize)
+        return _event(st, cfg, score_fn, temperature, curmaxsize, axis=axis)
 
     state = lax.fori_loop(0, total, body, state)
     state = state._replace(iteration=state.iteration + 1)
@@ -796,7 +843,79 @@ def run_iteration(state: EvoState, cfg: EvoConfig, score_fn) -> EvoState:
         state = _migrate(state, cfg, use_hof=False)
     if cfg.hof_migration:
         state = _migrate(state, cfg, use_hof=True)
+    if axis is not None:
+        # re-replicate the key: every shard derives the next key from the
+        # same iteration-entry key (shard streams diverged via fold_in above)
+        state = state._replace(key=jax.random.fold_in(key_in, 0x5EED))
     return state
+
+
+run_iteration = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
+    _run_iteration_impl
+)
+
+
+# ---------------------------------------------------------------------------
+# Multi-device: islands shard across the 'pop' mesh axis (SURVEY.md §2.2-2.3).
+# The TPU-native analogue of the reference's one-population-per-worker
+# assignment (/root/reference/src/SymbolicRegression.jl:837-1064): each device
+# owns I/n_pop islands; the only cross-device traffic per cycle is the [S+1]
+# frequency-delta psum and the [S+1, N] best-seen merge, riding ICI.
+# ---------------------------------------------------------------------------
+
+
+def evo_state_specs() -> EvoState:
+    """PartitionSpecs for an EvoState sharded along the island axis ('pop'):
+    per-member arrays shard their leading [I] dim; the frequency histogram,
+    best-seen frontier, PRNG key and counters are replicated — kept lockstep
+    by the collectives in _event / _run_iteration_impl."""
+    from jax.sharding import PartitionSpec as P
+
+    isl3 = P("pop", None, None)
+    isl2 = P("pop", None)
+    rep = P()
+    return EvoState(
+        kind=isl3, op=isl3, lhs=isl3, rhs=isl3, feat=isl3, val=isl3,
+        length=isl2, loss=isl2, score=isl2, birth=isl2,
+        freq=rep, bs_loss=rep, bs_tree=(rep,) * 7, bs_exists=rep,
+        key=rep, step=rep, num_evals=rep, iteration=rep,
+    )
+
+
+def shard_evo_state(state: EvoState, mesh) -> EvoState:
+    """Place an EvoState onto a mesh with the island axis sharded over 'pop'.
+    Requires cfg.n_islands divisible by the mesh's 'pop' axis size."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec_leaves = jax.tree_util.tree_leaves(
+        evo_state_specs(), is_leaf=lambda x: isinstance(x, P)
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    placed = [
+        jax.device_put(a, NamedSharding(mesh, s))
+        for a, s in zip(leaves, spec_leaves, strict=True)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
+def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn):
+    """Jitted run_iteration over a ('pop', ...) mesh via shard_map: each
+    device advances its own island slice through the full iteration;
+    frequency stats and the best-seen frontier stay globally lockstep via
+    in-program collectives. ``cfg_local.n_islands`` is the PER-SHARD island
+    count (global islands / pop-axis size)."""
+    specs = evo_state_specs()
+    fn = jax.shard_map(
+        lambda st: _run_iteration_impl(st, cfg_local, score_fn, axis="pop"),
+        mesh=mesh,
+        in_specs=(specs,),
+        out_specs=specs,
+        # replicated outputs are replicated by construction (psum/fold_in of
+        # replicated inputs); VMA inference can't see that through the scan
+        # interpreter, same as parallel/sharding.py
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:

@@ -757,7 +757,12 @@ def _make_loss_grad_kernel(
 
                 @pl.when(code == 0)
                 def _const_g():
-                    gval = jnp.sum(adj_i)
+                    # mask padded columns: their loss cotangent is 0, but a
+                    # tree singular exactly at the pad value (X=1) makes the
+                    # upstream vjp chain produce inf*0=NaN there; columns
+                    # never mix elsewhere, so masking this reduction is the
+                    # one place the pad lanes could leak into the gradient
+                    gval = jnp.sum(jnp.where(mask, adj_i, 0.0))
                     grad_ref[pl.ds(ti, 1), :] = grad_ref[
                         pl.ds(ti, 1), :
                     ] + jnp.where(lane == i, gval, 0.0)

@@ -176,3 +176,55 @@ def test_pallas_const_opt_fits_planted_constants():
     )
     res = equation_search(X, y, options=opts, niterations=6, verbosity=0)
     assert min(m.loss for m in res.pareto_frontier) < 1e-4
+
+
+def test_loss_grad_kernel_masks_padded_rows():
+    """Regression: a tree singular exactly at the dataset pad value (X=1.0,
+    weight 0) must still produce a finite constant gradient. _reshape_rows
+    pads rows with X=1; c/(x0-x1) is finite on real rows but inf at the pads,
+    and the reverse adjoint sweep turns the 0-weight cotangent into inf*0=NaN
+    there — the const-slot reduction must mask those columns out."""
+    from symbolicregression_jl_tpu.ops.constant_opt import _tree_loss_fn
+    from symbolicregression_jl_tpu.ops.interp import _Structure
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        make_pallas_loss_grad_fn,
+        pack_flat_fused,
+    )
+    from symbolicregression_jl_tpu.ops.losses import L2DistLoss
+    from symbolicregression_jl_tpu.tree import binary, constant, feature
+
+    opset = OPTS.operators
+    div = opset.binary_index("/")
+    sub = opset.binary_index("-")
+    # c / (x0 - x1): singular iff x0 == x1, which holds at every padded
+    # column (both padded to 1.0) and at no real row below
+    tree = binary(div, constant(2.0), binary(sub, feature(0), feature(1)))
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2, 333)).astype(np.float32)
+    X[1] = X[0] + np.sign(X[1] - X[0]) * np.maximum(np.abs(X[1] - X[0]), 0.1)
+    y = (2.0 / (X[0] - X[1])).astype(np.float32)
+
+    flat = flatten_trees([tree] * 16, OPTS.max_nodes)
+    ints, _ = pack_flat_fused(flat, opset)
+    fn = make_pallas_loss_grad_fn(X, y, None, opset, L2DistLoss)
+    losses_k, grads_k = fn(ints, jnp.asarray(flat.val), flat.kind.shape[1])
+    losses_k, grads_k = np.asarray(losses_k), np.asarray(grads_k)
+    assert np.isfinite(losses_k).all()
+    assert np.isfinite(grads_k).all(), "padded-row NaN leaked into gradients"
+
+    loss_fn = _tree_loss_fn(opset, L2DistLoss)
+    struct = _Structure(
+        *(jnp.asarray(a) for a in (flat.kind, flat.op, flat.lhs, flat.rhs,
+                                   flat.feat, flat.length))
+    )
+    import jax as _jax
+
+    val0, grad0 = _jax.value_and_grad(loss_fn)(
+        jnp.asarray(flat.val[0]), _jax.tree_util.tree_map(lambda a: a[0], struct),
+        jnp.asarray(X), jnp.asarray(y), jnp.zeros(()), False,
+    )
+    np.testing.assert_allclose(losses_k[0], float(val0), rtol=1e-3)
+    np.testing.assert_allclose(
+        grads_k[0][0], float(np.asarray(grad0)[0]), rtol=1e-2
+    )

@@ -1,0 +1,170 @@
+"""Multi-device island sharding of the device engine (ops/evolve.py).
+
+With populations divisible by the device count, device_search shards the
+island axis over a 'pop' mesh (shard_map): each device advances its own
+islands, and the frequency histogram / best-seen frontier stay lockstep via
+in-program collectives. These tests run on conftest's 8-device virtual CPU
+platform — the same mechanism the driver's dryrun_multichip validates.
+
+Reference counterpart: one-population-per-worker dispatch,
+/root/reference/src/SymbolicRegression.jl:837-1064.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.models.device_search import (
+    _make_score_fn,
+    build_evo_config,
+)
+from symbolicregression_jl_tpu.models.population import Population
+from symbolicregression_jl_tpu.ops import flatten_trees
+from symbolicregression_jl_tpu.ops.evolve import (
+    init_state,
+    make_sharded_iteration,
+    run_iteration,
+    shard_evo_state,
+)
+from symbolicregression_jl_tpu.parallel.mesh import make_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU platform"
+)
+
+
+def _problem(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+    return X, y
+
+
+def _setup(I=8, P=16, ncycles=3):
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=I,
+        population_size=P,
+        ncycles_per_iteration=ncycles,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    X, y = _problem()
+    cfg_g = build_evo_config(
+        options, n_features=2, baseline_loss=float(np.var(y)),
+        use_baseline=True, niterations=4,
+    )
+    rng = np.random.default_rng(0)
+    trees = Population.random_trees(I * P, options, 2, rng)
+    flat = flatten_trees(trees, options.max_nodes)
+    score_fn = _make_score_fn(X, y, None, options, use_pallas=False)
+    from symbolicregression_jl_tpu.ops.treeops import Tree
+
+    batch = Tree(
+        jnp.asarray(flat.kind), jnp.asarray(flat.op), jnp.asarray(flat.lhs),
+        jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
+        jnp.asarray(flat.length),
+    )
+    init_losses = np.asarray(jax.jit(score_fn)(batch))
+    return options, X, y, cfg_g, flat, init_losses, score_fn
+
+
+def test_sharded_iteration_matches_unsharded_invariants():
+    """Same initial state through the sharded and unsharded programs: both
+    must preserve the engine's invariants (valid lengths, finite frontier,
+    lockstep counters); RNG streams differ by construction."""
+    options, X, y, cfg_g, flat, init_losses, score_fn = _setup()
+    I, P = cfg_g.n_islands, cfg_g.pop_size
+    state = init_state(flat, init_losses, cfg_g, seed=7)
+
+    st_ref = run_iteration(state, cfg_g, score_fn)
+
+    n_dev = 4
+    mesh = make_mesh(n_dev, 1, jax.devices()[:n_dev])
+    cfg_l = build_evo_config(
+        options, n_features=2, baseline_loss=cfg_g.baseline_loss,
+        use_baseline=True, niterations=4, n_islands=I // n_dev,
+    )
+    step = make_sharded_iteration(mesh, cfg_l, score_fn)
+    st_sh = step(shard_evo_state(state, mesh))
+
+    for st in (st_ref, st_sh):
+        length = np.asarray(st.length)
+        assert ((length >= 1) & (length <= cfg_g.n_slots)).all()
+        best = float(jnp.min(jnp.where(st.bs_exists, st.bs_loss, jnp.inf)))
+        assert np.isfinite(best)
+        assert float(st.num_evals) > 0
+    # step clock advances identically (ncycles events on both paths)
+    assert int(st_ref.step) == int(st_sh.step)
+    # the sharded program's replicated outputs really are replicated: the
+    # frequency histogram psum + best-seen merge must yield one global value
+    freq = np.asarray(st_sh.freq)
+    assert freq.sum() >= np.asarray(state.freq).sum()
+
+
+def test_sharded_frontier_trees_carry_their_losses():
+    """The cross-shard best-seen merge broadcasts the owning shard's tree via
+    a masked psum: every merged frontier entry must decode to a tree whose
+    host-side evaluation reproduces the recorded loss (a mismatched merge —
+    loss from one shard, tree from another — would fail here)."""
+    from symbolicregression_jl_tpu.ops.flat import FlatTrees, unflatten_tree
+
+    options, X, y, cfg_g, flat, init_losses, score_fn = _setup(ncycles=6)
+    I, P = cfg_g.n_islands, cfg_g.pop_size
+    state = init_state(flat, init_losses, cfg_g, seed=11)
+    n_dev = 8
+    mesh = make_mesh(n_dev, 1, jax.devices()[:n_dev])
+    cfg_l = build_evo_config(
+        options, n_features=2, baseline_loss=cfg_g.baseline_loss,
+        use_baseline=True, niterations=4, n_islands=I // n_dev,
+    )
+    step = make_sharded_iteration(mesh, cfg_l, score_fn)
+    st = step(shard_evo_state(state, mesh))
+    st = step(st)
+
+    bs_loss = np.asarray(st.bs_loss)
+    bs_exists = np.asarray(st.bs_exists)
+    kind, op, lhs, rhs, feat, val, blen = (np.asarray(a) for a in st.bs_tree)
+    bsf = FlatTrees(
+        kind.astype(np.int32), op.astype(np.int32), lhs.astype(np.int32),
+        rhs.astype(np.int32), feat.astype(np.int32), val.astype(np.float32),
+        blen.astype(np.int32),
+    )
+    n_checked = 0
+    for s in range(cfg_g.maxsize + 1):
+        if not bs_exists[s] or blen[s] < 1:
+            continue
+        tree = unflatten_tree(bsf, s)
+        assert tree.count_nodes() == int(blen[s]) == s
+        pred = tree.eval_np(X.astype(np.float64), options.operators)
+        true_loss = float(np.mean((pred - y.astype(np.float64)) ** 2))
+        assert true_loss == pytest.approx(float(bs_loss[s]), rel=1e-3, abs=1e-5)
+        n_checked += 1
+    assert n_checked >= 2
+
+
+def test_device_search_engages_mesh_end_to_end():
+    """populations == device count: the public API must route through the
+    sharded engine and still solve the planted problem."""
+    X, y = _problem(n=100)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=8,  # divisible by the 8 virtual devices -> mesh engages
+        population_size=16,
+        ncycles_per_iteration=60,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    res = equation_search(X, y, options=options, niterations=5, verbosity=0)
+    assert min(m.loss for m in res.pareto_frontier) < 1.5
+    assert all(
+        m.tree.count_nodes() >= 1 for p in res.populations for m in p.members
+    )
