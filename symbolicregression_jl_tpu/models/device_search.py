@@ -350,7 +350,7 @@ def _accept_and_scatter(
             jnp.where(improved, state.step, state.birth[ii, pp])
         ),
         key=key,
-        num_evals=state.num_evals + jnp.asarray(n_evals, jnp.float32),
+        num_evals=state.num_evals + n_evals,
     )
 
 
@@ -552,6 +552,27 @@ def _decode_readback(buf: np.ndarray, cfg: EvoConfig):
     return bs_loss, bs_exists, bs_len, fields, num_evals
 
 
+def _hof_pool_np(decoded_rows, cfg: EvoConfig):
+    """Concatenate every process's decoded best-seen frontier into one
+    migration pool (8-tuple, _topn_pool layout) as host numpy arrays."""
+    kinds, ops, lhss, rhss, feats, vals, lens, losses = ([] for _ in range(8))
+    for bs_loss, bs_exists, bs_len, fields, _ in decoded_rows:
+        kind, op, lhs, rhs, feat, val = fields
+        kinds.append(kind.astype(np.int32))
+        ops.append(op.astype(np.int32))
+        lhss.append(lhs.astype(np.int32))
+        rhss.append(rhs.astype(np.int32))
+        feats.append(feat.astype(np.int32))
+        vals.append(val.astype(np.float32))
+        lens.append(np.where(bs_exists, bs_len, 0).astype(np.int32))
+        losses.append(np.where(bs_exists, bs_loss, np.inf).astype(np.float32))
+    return (
+        np.concatenate(kinds), np.concatenate(ops), np.concatenate(lhss),
+        np.concatenate(rhss), np.concatenate(feats), np.concatenate(vals),
+        np.concatenate(lens), np.concatenate(losses),
+    )
+
+
 def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
     """Decode best-seen rows into host PopMembers."""
     members = []
@@ -596,7 +617,33 @@ def device_search_one_output(
             "use scheduler='lockstep'"
         )
 
+    # --- multi-host (SPMD over DCN): every process runs this same function on
+    # its own island slice; the only cross-host traffic is the once-per-
+    # iteration migration-pool + readback allgather below (the reference
+    # ships whole pickled Populations through the head process for the same
+    # purpose, /root/reference/src/SymbolicRegression.jl:837-1064).
+    from ..parallel import distributed as dist
+
+    n_proc = jax.process_count()
+    proc_id = jax.process_index()
+    multi_host = n_proc > 1
+    head = proc_id == 0
+
     I, P = options.populations, options.population_size
+    if multi_host:
+        # even split required: the per-iteration allgather needs identical
+        # pool shapes on every process, and this check must raise on ALL
+        # processes (an uneven raise would leave survivors deadlocked in
+        # their first collective)
+        if I % n_proc != 0:
+            raise ValueError(
+                f"multi-host search needs populations divisible by the "
+                f"process count (populations={I}, processes={n_proc})"
+            )
+        isl_start, isl_stop = dist.process_island_slice(I)
+        I = isl_stop - isl_start
+        # decorrelate this process's initial populations and engine RNG
+        rng = np.random.default_rng([int(rng.integers(0, 2**31 - 1)), proc_id])
     N = options.max_nodes
     X = dataset.X.astype(np.float32)
     y = dataset.y.astype(np.float32)
@@ -623,20 +670,27 @@ def device_search_one_output(
         baseline_loss=dataset.baseline_loss,
         use_baseline=use_baseline,
         niterations=niterations,
+        n_islands=I,
     )
+    if multi_host and (options.migration or options.hof_migration):
+        # cross-host pools (injected once per iteration below) subsume the
+        # in-program local migration: the pool is then GLOBAL across all
+        # processes' islands, matching the reference's head-mediated
+        # migration (/root/reference/src/Migration.jl:16-38)
+        cfg = dataclasses.replace(cfg, migration=False, hof_migration=False)
 
     # --- multi-device: shard the island axis over a 'pop' mesh --------------
     # Each device owns I/n_dev islands; per-cycle cross-device traffic is the
     # frequency-delta psum + best-seen merge (ops/evolve.py). Within-device
     # migration uses the local topn pool; cross-device mixing rides the
     # globally-merged best-seen frontier (hof_migration).
-    n_dev = len(jax.devices())
+    n_dev = jax.local_device_count()
     mesh = None
     cfg_local = cfg
     if n_dev > 1 and I % n_dev == 0:
         from ..parallel.mesh import make_mesh
 
-        mesh = make_mesh(n_dev, 1)
+        mesh = make_mesh(n_dev, 1, jax.local_devices())
         cfg_local = dataclasses.replace(cfg, n_islands=I // n_dev)
 
     use_pallas = jax.devices()[0].platform != "cpu"
@@ -773,18 +827,59 @@ def device_search_one_output(
     stop_reason = None
     num_evals = 0.0
 
+    from ..ops.evolve import extract_topn_pool, migrate_from_pool
+
     for it in range(niterations):
         state = run_step(state)
         if copt_step is not None:
             state = copt_step(state)
         buf = np.asarray(readback_step(state))  # the iteration's ONE readback
-        bs_loss, bs_exists, bs_len, fields, num_evals = _decode_readback(buf, cfg)
-        for m in _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg, options):
-            hof.update(m, options)
 
-        if output_file and options.save_to_file:
+        if multi_host:
+            # --- the iteration's single cross-host exchange (DCN): this
+            # process's readback buffer + topn migration pool, allgathered ---
+            pool_local = tuple(
+                np.asarray(a) for a in extract_topn_pool(state, cfg)
+            )
+            gathered = dist.all_gather_migration_pool((buf, *pool_local))
+            decoded = [
+                _decode_readback(np.asarray(gathered[0][pi]), cfg)
+                for pi in range(n_proc)
+            ]
+            num_evals = sum(d[4] for d in decoded)
+            for d in decoded:
+                for m in _bs_to_members(d[0], d[1], d[2], d[3], cfg, options):
+                    hof.update(m, options)
+            # inject the now-global pools: all processes' topn members with
+            # fraction_replaced, all processes' best-seen frontiers with
+            # fraction_replaced_hof (reference migrate! semantics)
+            if options.migration:
+                topn_pool = tuple(
+                    jnp.asarray(g.reshape((-1,) + g.shape[2:]))
+                    for g in gathered[1:]
+                )
+                state = migrate_from_pool(
+                    state, cfg, topn_pool, float(options.fraction_replaced)
+                )
+            if options.hof_migration:
+                hof_pool = tuple(
+                    jnp.asarray(a) for a in _hof_pool_np(decoded, cfg)
+                )
+                state = migrate_from_pool(
+                    state, cfg, hof_pool, float(options.fraction_replaced_hof)
+                )
+        else:
+            bs_loss, bs_exists, bs_len, fields, num_evals = _decode_readback(
+                buf, cfg
+            )
+            for m in _bs_to_members(
+                bs_loss, bs_exists, bs_len, fields, cfg, options
+            ):
+                hof.update(m, options)
+
+        if output_file and options.save_to_file and head:
             save_hall_of_fame(output_file, hof, options, dataset.variable_names)
-        if verbosity > 0:
+        if verbosity > 0 and head:
             elapsed = time.time() - start_time
             print(
                 f"[device iter {it + 1}/{niterations}] evals={num_evals:.3g} "
@@ -796,23 +891,36 @@ def device_search_one_output(
                 )
             )
 
+        # stop decision — in multi-host mode it must be LOCKSTEP: any
+        # process's local trigger (head's stdin, clock skew on timeout) is
+        # allgathered so every process breaks on the same iteration
+        stop_code = 0
         if early_stop is not None and any(
             early_stop(m.loss, m.get_complexity(options))
             for m in hof.pareto_frontier()
         ):
-            stop_reason = "early_stop"
-            break
-        if (
+            stop_code = 1
+        elif (
             options.timeout_in_seconds is not None
             and time.time() - start_time > options.timeout_in_seconds
         ):
-            stop_reason = "timeout"
-            break
-        if options.max_evals is not None and num_evals >= options.max_evals:
-            stop_reason = "max_evals"
-            break
-        if stdin_reader.check_for_user_quit():
-            stop_reason = "user_quit"
+            stop_code = 2
+        elif options.max_evals is not None and num_evals >= options.max_evals:
+            stop_code = 3
+        elif head and stdin_reader.check_for_user_quit():
+            stop_code = 4
+        if multi_host:
+            stop_code = int(
+                np.max(
+                    dist.all_gather_migration_pool(
+                        np.asarray([stop_code], np.int32)
+                    )
+                )
+            )
+        if stop_code:
+            stop_reason = {
+                1: "early_stop", 2: "timeout", 3: "max_evals", 4: "user_quit"
+            }[stop_code]
             break
 
     stdin_reader.close()
@@ -831,6 +939,7 @@ def device_search_one_output(
     loss = np_at(state.loss).astype(np.float64)
     score = np_at(state.score).astype(np.float64)
     pops = []
+    final_slots = []
     for i in range(I):
         flat_i = FlatTrees(
             kind[i], opa[i], lhs[i], rhs[i], feat[i], val[i], length[i]
@@ -845,8 +954,41 @@ def device_search_one_output(
                 complexity=int(length[i, p]),
             )
             members.append(m)
-            hof.update(m, options)
+            if multi_host:
+                final_slots.append((i, p))  # deferred: lockstep sync below
+            else:
+                hof.update(m, options)
         pops.append(Population(members))
+
+    if multi_host:
+        # final lockstep hof sync: the last const-opt's improvements live
+        # only in state.loss/val (the bs frontier is updated by _event, not
+        # const-opt), so folding LOCAL members into the hof here would make
+        # per-process hofs diverge after the last exchange. Instead exchange
+        # a best-per-complexity snapshot of the final populations and let
+        # every process merge the same global set.
+        S1 = cfg.maxsize + 1
+        fl = np.full((S1,), np.inf, np.float32)
+        fn_ = np.zeros((S1,), np.float32)
+        ffields = [np.zeros((S1, N), np.float32) for _ in range(6)]
+        for i, p in final_slots:
+            s = min(int(length[i, p]), cfg.maxsize)
+            if np.isfinite(loss[i, p]) and loss[i, p] < fl[s]:
+                fl[s] = loss[i, p]
+                fn_[s] = length[i, p]
+                for arr, src in zip(
+                    ffields, (kind, opa, lhs, rhs, feat, val)
+                ):
+                    arr[s] = src[i, p]
+        g = dist.all_gather_migration_pool((fl, fn_, *ffields))
+        for pi in range(n_proc):
+            bl = np.asarray(g[0][pi])
+            bn = np.asarray(g[1][pi]).astype(np.int32)
+            flds = [np.asarray(g[2 + j][pi]) for j in range(6)]
+            for m in _bs_to_members(
+                bl, np.isfinite(bl), bn, flds, cfg, options
+            ):
+                hof.update(m, options)
 
     result = SearchResult(
         hall_of_fame=hof,

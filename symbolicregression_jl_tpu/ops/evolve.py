@@ -63,6 +63,8 @@ __all__ = [
     "evo_state_specs",
     "shard_evo_state",
     "make_sharded_iteration",
+    "extract_topn_pool",
+    "migrate_from_pool",
 ]
 
 
@@ -918,37 +920,35 @@ def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn):
     return jax.jit(fn)
 
 
-def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
-    """Replace random members with samples from the migration pool: topn per
-    island (best_sub_pop) or the best-seen frontier (hof)."""
-    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
-    S = cfg.maxsize
-    key, k_sel, k_pick = jax.random.split(state.key, 3)
-    frac = cfg.fraction_replaced_hof if use_hof else cfg.fraction_replaced
+def _topn_pool(state: EvoState, cfg: EvoConfig):
+    """Migration pool from the islands' best members: topn per island
+    (best_sub_pop, /root/reference/src/Migration.jl:25-31). Returns the
+    8-tuple (kind, op, lhs, rhs, feat, val, length, loss), rows [I*topn]."""
+    I, N = cfg.n_islands, cfg.n_slots
+    k = cfg.topn
+    top_idx = jnp.argsort(state.score, axis=1)[:, :k]  # [I, k]
+    isl = jnp.arange(I, dtype=jnp.int32)[:, None]
+    return (
+        state.kind[isl, top_idx].reshape(I * k, N),
+        state.op[isl, top_idx].reshape(I * k, N),
+        state.lhs[isl, top_idx].reshape(I * k, N),
+        state.rhs[isl, top_idx].reshape(I * k, N),
+        state.feat[isl, top_idx].reshape(I * k, N),
+        state.val[isl, top_idx].reshape(I * k, N),
+        state.length[isl, top_idx].reshape(I * k),
+        state.loss[isl, top_idx].reshape(I * k),
+    )
 
-    if use_hof:
-        pool_loss = jnp.where(state.bs_exists, state.bs_loss, jnp.inf)
-        pool_fields = state.bs_tree  # [S+1, ...]
-        pool_n = S + 1
-        pool_valid = state.bs_exists
-        pk, po, pl, pr, pf, pv, pln = pool_fields
-        pool_kind, pool_op, pool_lhs, pool_rhs, pool_feat, pool_val, pool_len = (
-            pk, po, pl, pr, pf, pv, pln
-        )
-    else:
-        k = cfg.topn
-        top_idx = jnp.argsort(state.score, axis=1)[:, :k]  # [I, k]
-        isl = jnp.arange(I, dtype=jnp.int32)[:, None]
-        pool_kind = state.kind[isl, top_idx].reshape(I * k, N)
-        pool_op = state.op[isl, top_idx].reshape(I * k, N)
-        pool_lhs = state.lhs[isl, top_idx].reshape(I * k, N)
-        pool_rhs = state.rhs[isl, top_idx].reshape(I * k, N)
-        pool_feat = state.feat[isl, top_idx].reshape(I * k, N)
-        pool_val = state.val[isl, top_idx].reshape(I * k, N)
-        pool_len = state.length[isl, top_idx].reshape(I * k)
-        pool_loss = state.loss[isl, top_idx].reshape(I * k)
-        pool_n = I * k
-        pool_valid = jnp.isfinite(pool_loss)
+
+def _inject_pool(state: EvoState, cfg: EvoConfig, pool, pool_valid, frac) -> EvoState:
+    """Replace Bernoulli(frac)-chosen members with uniform samples from the
+    (masked) pool; the core of every migration variant. ``pool`` is the
+    8-tuple layout of _topn_pool; rows where ~pool_valid are never drawn."""
+    I, P = cfg.n_islands, cfg.pop_size
+    (pool_kind, pool_op, pool_lhs, pool_rhs, pool_feat, pool_val,
+     pool_len, pool_loss) = pool
+    pool_n = pool_loss.shape[0]
+    key, k_sel, k_pick = jax.random.split(state.key, 3)
 
     # Bernoulli(frac) per member (reference draws a Poisson count: same mean)
     replace = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32) < frac
@@ -959,8 +959,8 @@ def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
     probs = probs / jnp.maximum(jnp.sum(probs), 1e-30)
     src = jax.random.choice(k_pick, pool_n, shape=(I, P), p=probs)
 
-    def mix(cur, pool):
-        take = pool[src]  # [I, P, ...]
+    def mix(cur, pool_f):
+        take = pool_f[src]  # [I, P, ...]
         m = replace.reshape((I, P) + (1,) * (cur.ndim - 2))
         return jnp.where(m, take, cur)
 
@@ -980,3 +980,38 @@ def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
         birth=jnp.where(replace, state.step, state.birth),
         key=key,
     )
+
+
+def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
+    """Replace random members with samples from the migration pool: topn per
+    island (best_sub_pop) or the best-seen frontier (hof)."""
+    if use_hof:
+        pk, po, pl, pr, pf, pv, pln = state.bs_tree
+        pool = (pk, po, pl, pr, pf, pv, pln,
+                jnp.where(state.bs_exists, state.bs_loss, jnp.inf))
+        pool_valid = state.bs_exists
+        frac = cfg.fraction_replaced_hof
+    else:
+        pool = _topn_pool(state, cfg)
+        pool_valid = jnp.isfinite(pool[7])
+        frac = cfg.fraction_replaced
+    return _inject_pool(state, cfg, pool, pool_valid, frac)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def extract_topn_pool(state: EvoState, cfg: EvoConfig):
+    """Jitted pool extraction for the cross-host exchange: this process's
+    topn-per-island migration pool, read back compactly and allgathered over
+    DCN once per iteration (models/device_search.py). The multi-host
+    analogue of the reference shipping best_sub_pops through the head
+    process (/root/reference/src/SymbolicRegression.jl:837-881)."""
+    return _topn_pool(state, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "frac"))
+def migrate_from_pool(state: EvoState, cfg: EvoConfig, pool, frac: float) -> EvoState:
+    """Jitted external-pool migration: inject an (allgathered, cross-host)
+    pool into this process's islands with Bernoulli(frac) replacement.
+    Invalid rows (non-finite loss or length < 1) are never drawn."""
+    pool_valid = jnp.isfinite(pool[7]) & (pool[6] >= 1)
+    return _inject_pool(state, cfg, pool, pool_valid, frac)

@@ -1,0 +1,161 @@
+"""Multi-host orchestration: a REAL 2-process search over jax.distributed.
+
+Spawns two fresh interpreters that join one JAX runtime via
+``jax.distributed.initialize`` (Gloo CPU collectives standing in for DCN),
+each owning half the islands (process_island_slice), exchanging the
+migration pool + readback once per iteration (all_gather_migration_pool),
+and both must converge on the planted equation with IDENTICAL halls of fame
+— the lockstep property the cross-host exchange guarantees.
+
+Reference counterpart: the :multiprocessing backend's head-mediated search
+(/root/reference/src/SymbolicRegression.jl:297-320,837-1064,
+/root/reference/src/Configure.jl:309-343).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+from symbolicregression_jl_tpu.parallel.distributed import initialize, is_distributed
+initialize(coordinator_address="localhost:{port}", num_processes=2, process_id=pid)
+assert is_distributed(), "expected a 2-process runtime"
+
+import numpy as np
+from symbolicregression_jl_tpu import Options, equation_search
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2, 100)).astype(np.float32)
+y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+options = Options(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    populations=4,            # 2 islands per process
+    population_size=16,
+    ncycles_per_iteration=60,
+    maxsize=14,
+    save_to_file=False,
+    seed=0,
+    scheduler="device",
+)
+res = equation_search(X, y, options=options, niterations=4, verbosity=0)
+best = min(m.loss for m in res.pareto_frontier)
+# local population slice: this process owns exactly its 2 islands
+assert len(res.populations) == 2, len(res.populations)
+frontier = ";".join(
+    f"{{m.get_complexity(options)}}:{{m.loss:.6g}}"
+    for m in sorted(res.hall_of_fame.pareto_frontier(),
+                    key=lambda m: m.get_complexity(options))
+)
+print(f"RESULT p{{pid}} best={{best:.6g}} evals={{res.num_evals:.0f}} "
+      f"frontier=[{{frontier}}]", flush=True)
+"""
+
+
+_UNEVEN_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+from symbolicregression_jl_tpu.parallel.distributed import initialize
+initialize(coordinator_address="localhost:{port}", num_processes=2, process_id=pid)
+import numpy as np
+from symbolicregression_jl_tpu import Options, equation_search
+X = np.random.default_rng(0).normal(size=(2, 32)).astype(np.float32)
+y = X[0].astype(np.float32)
+options = Options(
+    binary_operators=["+"], populations=5, population_size=8,
+    ncycles_per_iteration=2, save_to_file=False, scheduler="device",
+)
+try:
+    equation_search(X, y, options=options, niterations=1, verbosity=0)
+except ValueError as e:
+    assert "divisible" in str(e), e
+    print(f"RAISED p{{pid}}", flush=True)
+else:
+    print(f"NORAISE p{{pid}}", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pair(tmp_path, template, port, timeout=900):
+    script = tmp_path / "worker.py"
+    script.write_text(template.format(repo=REPO, port=port))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_cpu_enable_fast_math=true"
+        " --xla_cpu_fast_math_honor_nans=true"
+        " --xla_cpu_fast_math_honor_infs=true"
+        " --xla_cpu_fast_math_honor_division=true"
+        " --xla_cpu_fast_math_honor_functions=true"
+    ).strip()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out)
+    return procs, outs
+
+
+def test_uneven_island_split_raises_on_every_process(tmp_path):
+    """populations not divisible by process count must raise on BOTH
+    processes (a one-sided raise would deadlock the survivor in its first
+    collective)."""
+    procs, outs = _run_pair(tmp_path, _UNEVEN_WORKER, _free_port(), timeout=300)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} crashed:\n{out}"
+        assert f"RAISED p{i}" in out, out
+
+
+def test_two_process_search_recovers_and_stays_lockstep(tmp_path):
+    procs, outs = _run_pair(tmp_path, _WORKER, _free_port())
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT p"):
+                tag = line.split()[1]
+                results[tag] = line
+    assert set(results) == {"p0", "p1"}, results
+
+    # both processes recovered the planted equation...
+    for tag in ("p0", "p1"):
+        best = float(results[tag].split("best=")[1].split()[0])
+        assert best < 1.5, results[tag]
+    # ...counted evals from BOTH processes (global, not local, throughput)...
+    evals = float(results["p0"].split("evals=")[1].split()[0])
+    assert evals > 2000
+    # ...and the halls of fame are IDENTICAL across processes: the readback
+    # allgather makes every process merge the same global frontier
+    f0 = results["p0"].split("frontier=")[1]
+    f1 = results["p1"].split("frontier=")[1]
+    assert f0 == f1, f"\np0: {f0}\np1: {f1}"
