@@ -178,6 +178,26 @@ def main():
     useful_flops = pipeline_evals * avg_nodes * N_ROWS
     mfu = useful_flops / V5E_VPU_FLOPS
 
+    # --- end-to-end device-engine throughput (the honest search number) -----
+    # The scoring-op rate above is the kernel's best regime; a real search
+    # also pays tournament/mutation/crossover/accept/migration/const-opt and
+    # one readback per iteration. Runs in a FRESH SUBPROCESS: this process's
+    # backend is already drained into the poisoned sync-dispatch regime, which
+    # was measured to understate the search rate ~4x.
+    import subprocess
+    import sys
+
+    e2e = {}
+    if use_pallas:  # the north-star e2e config is intractable on CPU hosts
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--e2e-only"],
+                capture_output=True, text=True, timeout=1800,
+            )
+            e2e = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001 — never lose the primary metric
+            e2e = {"end_to_end_error": f"{type(e).__name__}: {e}"}
+
     print(
         json.dumps(
             {
@@ -195,11 +215,64 @@ def main():
                 "sync_regime_evals_per_sec": round(sync_evals, 1),
                 "avg_nodes_per_tree": round(avg_nodes, 2),
                 "vpu_utilization_est": round(mfu, 4),
+                **e2e,
             }
         )
     )
     return total  # keep the reduction live
 
 
+def e2e_main():
+    """End-to-end device-engine search throughput at the north-star config.
+    Differencing a 1-iteration and a 4-iteration run (shared jit cache)
+    cancels compile + warmup; prints ONE JSON line consumed by main()."""
+    import jax
+
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, N_ROWS)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=100,
+        population_size=100,
+        ncycles_per_iteration=550,
+        maxsize=20,
+        save_to_file=False,
+        seed=0,
+        scheduler="device" if jax.devices()[0].platform != "cpu" else "lockstep",
+    )
+
+    def timed_search(niters):
+        t0 = time.time()
+        res = equation_search(X, y, options=options, niterations=niters, verbosity=0)
+        return res.num_evals, time.time() - t0
+
+    e1, w1 = timed_search(1)  # pays compile + warmup
+    e4, w4 = timed_search(4)  # cached: 3 extra steady-state iterations
+    rate = (e4 - e1) / max(w4 - w1, 1e-9)
+    print(
+        json.dumps(
+            {
+                "end_to_end_evals_per_sec": round(rate, 1),
+                "end_to_end_scheduler": options.scheduler,
+                "end_to_end_iters_timed": 3,
+                "end_to_end_vs_baseline": round(rate / REF_EVALS_PER_SEC_ESTIMATE, 2),
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--e2e-only" in sys.argv:
+        e2e_main()
+    else:
+        main()

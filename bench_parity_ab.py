@@ -1,0 +1,123 @@
+"""Device-vs-lockstep parity A/B: same problems, same seeds, both engines.
+
+The device engine deviates from the host lockstep engine in documented ways
+(one mutation attempt per event vs <=10 retries, a cycle's events batched
+against one population snapshot, Bernoulli migration, no in-cycle simplify —
+ops/evolve.py module docstring). This benchmark quantifies what those
+deviations cost in SEARCH QUALITY: Pareto fronts and best-loss trajectories
+for both engines on BASELINE.md configs 1 and 3, matched on iteration count.
+
+Reference accept semantics both engines target:
+/root/reference/src/Mutate.jl:247-317.
+
+Emits one JSON line per (config, scheduler) run plus a summary comparing the
+fronts. The committed artifact is PARITY_AB_r{N}.json.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _frontier(res, options):
+    rows = {}
+    for m in sorted(res.pareto_frontier, key=lambda m: m.get_complexity(options)):
+        rows[m.get_complexity(options)] = round(float(m.loss), 8)
+    return rows
+
+
+def _run(config_name, scheduler, X, y, opt_kwargs, niterations, seed):
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    options = Options(save_to_file=False, seed=seed, scheduler=scheduler, **opt_kwargs)
+    t0 = time.time()
+    res = equation_search(X, y, options=options, niterations=niterations, verbosity=0)
+    wall = time.time() - t0
+    front = _frontier(res, options)
+    return {
+        "config": config_name,
+        "scheduler": scheduler,
+        "seed": seed,
+        "wall_s": round(wall, 1),
+        "best_loss": min(front.values()),
+        "num_evals": round(res.num_evals, 0),
+        "front": front,
+    }
+
+
+def config1_problem():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 100)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    kwargs = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=20,
+        maxsize=20,
+    )
+    return X, y, kwargs
+
+
+def config3_problem():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, 10_000)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    kwargs = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=100,
+        population_size=100,
+        ncycles_per_iteration=550,
+        maxsize=20,
+    )
+    return X, y, kwargs
+
+
+def main(full: bool = True):
+    results = []
+    seeds = [0, 1, 2]
+
+    X, y, kw = config1_problem()
+    for seed in seeds:
+        for sched in ("device", "lockstep"):
+            r = _run("1_readme_example", sched, X, y, kw, niterations=20, seed=seed)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+
+    if full:
+        X, y, kw = config3_problem()
+        for sched in ("device", "lockstep"):
+            r = _run("3_bench_10k_100x100", sched, X, y, kw, niterations=4, seed=0)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+
+    # summary: per config, best loss of each engine across seeds + the ratio
+    summary = {"metric": "device_vs_lockstep_parity"}
+    for config in {r["config"] for r in results}:
+        dev = [r["best_loss"] for r in results
+               if r["config"] == config and r["scheduler"] == "device"]
+        lock = [r["best_loss"] for r in results
+                if r["config"] == config and r["scheduler"] == "lockstep"]
+        dev_best, lock_best = min(dev), min(lock)
+        summary[config] = {
+            "device_best_loss": dev_best,
+            "lockstep_best_loss": lock_best,
+            "device_per_seed": dev,
+            "lockstep_per_seed": lock,
+            # +1e-12: both engines hit exact float32 zero on recoverable targets
+            "log10_ratio": round(
+                float(np.log10((dev_best + 1e-12) / (lock_best + 1e-12))), 2
+            ),
+        }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(full="--quick" not in sys.argv)

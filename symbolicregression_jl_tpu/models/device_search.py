@@ -837,9 +837,13 @@ def device_search_one_output(
 
         if multi_host:
             # --- the iteration's single cross-host exchange (DCN): this
-            # process's readback buffer + topn migration pool, allgathered ---
-            pool_local = tuple(
-                np.asarray(a) for a in extract_topn_pool(state, cfg)
+            # process's readback buffer + topn migration pool, allgathered.
+            # The pool readback is skipped when migration is off (options are
+            # identical on every process, so the exchange stays uniform) ---
+            pool_local = (
+                tuple(np.asarray(a) for a in extract_topn_pool(state, cfg))
+                if options.migration
+                else ()
             )
             gathered = dist.all_gather_migration_pool((buf, *pool_local))
             decoded = [

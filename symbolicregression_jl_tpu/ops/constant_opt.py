@@ -196,8 +196,22 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
 
     Per reference semantics, trees with exactly ONE constant always use
     Newton+backtracking; others use the configured algorithm
-    (/root/reference/src/ConstantOptimization.jl:22-41)."""
-    loss_fn = _tree_loss_fn(opset, loss_elem)
+    (/root/reference/src/ConstantOptimization.jl:22-41).
+
+    Memory discipline: the batch runs as lax.map over chunks of
+    SR_CONSTOPT_CHUNK trees (default 8) with the interpreter rematerialized
+    in the backward pass — a fully vmapped batch materializes [P, S, N, R]
+    residuals, which at the 10k-row x 100x100-population config is tens of
+    GB (observed: 46G requested on a 16G chip). Same tuning as the device
+    engine's fallback (models/device_search.py)."""
+    import os
+
+    loss_fn_raw = _tree_loss_fn(opset, loss_elem)
+    _ck = jax.checkpoint(lambda v, s: loss_fn_raw(v, s, X, y, w, has_w))
+
+    def loss_fn(v, s, X_, y_, w_, hw_):
+        return _ck(v, s)
+
     structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
     mask = flat.kind == KIND_CONST  # [P, N]
     main = _bfgs_single if algorithm == "BFGS" else _neldermead_single
@@ -220,9 +234,20 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
         best = jnp.argmin(fs)
         return vals[best], fs[best]
 
-    return jax.vmap(per_tree)(
-        _Structure(*(jnp.asarray(a) for a in structure)), starts, mask
+    structure = _Structure(*(jnp.asarray(a) for a in structure))
+    P = starts.shape[0]
+    chunk = max(1, min(int(os.environ.get("SR_CONSTOPT_CHUNK", 8)), P))
+    while P % chunk:
+        chunk -= 1
+    n_chunks = P // chunk
+    if n_chunks == 1:
+        return jax.vmap(per_tree)(structure, starts, mask)
+    chunked = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]),
+        (structure, starts, mask),
     )
+    vals, fs = lax.map(lambda args: jax.vmap(per_tree)(*args), chunked)
+    return vals.reshape((P,) + vals.shape[2:]), fs.reshape((P,))
 
 
 def _optimize_constants_custom_objective(trees, scorer, options, rng):

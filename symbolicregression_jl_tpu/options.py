@@ -173,6 +173,10 @@ class Options:
     #   fastest on TPU, subset of features (see device_mode_supported);
     # "async": reference-style async island scheduler (parallel/islands.py)
     scheduler: str = "lockstep"
+    # worker threads for the async island scheduler (None: min(populations, 8)
+    # — the reference's analogue is one Julia Task per population,
+    # /root/reference/src/SearchUtils.jl:121-122)
+    async_workers: int | None = None
     # compile the scoring/const-opt/iteration programs before the timed
     # loop so iteration 1 runs at steady-state speed (the reference
     # precompiles its workload at package build,
@@ -202,6 +206,8 @@ class Options:
                 f"unknown scheduler {self.scheduler!r}; "
                 "expected 'lockstep', 'device', or 'async'"
             )
+        if self.async_workers is not None and self.async_workers < 1:
+            raise ValueError("async_workers must be >= 1 (or None for auto)")
         if self.optimizer_algorithm not in ("BFGS", "NelderMead"):
             raise ValueError(
                 f"unsupported optimizer_algorithm {self.optimizer_algorithm!r}; "

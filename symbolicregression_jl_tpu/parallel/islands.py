@@ -153,7 +153,10 @@ def async_search_one_output(
     )
 
     def on_complete(i: int, pop: Population, best_seen: HallOfFame):
-        """Head-side merge (reference main loop :896-1006)."""
+        """Head-side merge (reference main loop :896-1006). The lock guards
+        only the shared-state mutations; CSV writes and progress rendering
+        run on a hof SNAPSHOT outside it, so at 100+ islands completions
+        serialize on microseconds of merging, not file IO."""
         t_head = time.time()
         with lock:
             pops[i] = pop
@@ -177,33 +180,43 @@ def async_search_one_output(
                     migrate(
                         frontier, pops[i], options, options.fraction_replaced_hof, rng
                     )
-            if output_file and options.save_to_file:
-                save_hall_of_fame(output_file, hof, options, dataset.variable_names)
-            reporter.update(
-                hof, scorer.num_evals, dataset.variable_names,
-                y_variable_name=dataset.y_variable_name,
-            )
-            # stop conditions (reference :1053-1060)
-            if early_stop is not None and any(
-                early_stop(m.loss, m.get_complexity(options))
-                for m in hof.pareto_frontier()
-            ):
-                stop_reason[0] = "early_stop"
-            if (
-                options.timeout_in_seconds is not None
-                and time.time() - start_time > options.timeout_in_seconds
-            ):
-                stop_reason[0] = "timeout"
-            if options.max_evals is not None and scorer.num_evals >= options.max_evals:
-                stop_reason[0] = "max_evals"
-            if stdin_reader.check_for_user_quit():
-                stop_reason[0] = "user_quit"
+            hof_snapshot = hof.copy()
+
+        if output_file and options.save_to_file:
+            # atomic-replace CSV (export_csv) — concurrent snapshots may race
+            # on recency but never corrupt the file
+            save_hall_of_fame(output_file, hof_snapshot, options, dataset.variable_names)
+        reporter.update(
+            hof_snapshot, scorer.num_evals, dataset.variable_names,
+            y_variable_name=dataset.y_variable_name,
+        )
+        # stop conditions (reference :1053-1060); stop_reason writes are
+        # idempotent, so no lock is needed around them
+        if early_stop is not None and any(
+            early_stop(m.loss, m.get_complexity(options))
+            for m in hof_snapshot.pareto_frontier()
+        ):
+            stop_reason[0] = "early_stop"
+        if (
+            options.timeout_in_seconds is not None
+            and time.time() - start_time > options.timeout_in_seconds
+        ):
+            stop_reason[0] = "timeout"
+        if options.max_evals is not None and scorer.num_evals >= options.max_evals:
+            stop_reason[0] = "max_evals"
+        if stdin_reader.check_for_user_quit():
+            stop_reason[0] = "user_quit"
         # head-node occupancy (reference: ResourceMonitor + >40% warning,
         # /root/reference/src/SearchUtils.jl:217-284)
         reporter.head_work(time.time() - t_head)
         reporter.maybe_warn_occupancy()
 
-    max_workers = min(n_islands, 8)
+    max_workers = (
+        options.async_workers
+        if options.async_workers is not None
+        else min(n_islands, 8)
+    )
+    max_workers = min(max_workers, n_islands)
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
         pending = {}
         for i in range(n_islands):
