@@ -70,13 +70,10 @@ def main():
         save_to_file=False,
     )
     opset, loss_elem = options.operators, options.loss
+    from bench_problems import config3_data
+
     rng = np.random.default_rng(0)
-    X = rng.normal(size=(5, N_ROWS)).astype(np.float32)
-    y = (
-        np.cos(2.13 * X[0])
-        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
-        - 0.3 * np.abs(X[3]) ** 1.5
-    ).astype(np.float32)
+    X, y = config3_data(N_ROWS, rng=rng)
 
     trees = Population.random_trees(N_TREES, options, 5, rng)
     padded = trees + trees[: P_PAD - N_TREES]
@@ -228,15 +225,10 @@ def e2e_main():
     cancels compile + warmup; prints ONE JSON line consumed by main()."""
     import jax
 
+    from bench_problems import config3_data
     from symbolicregression_jl_tpu import Options, equation_search
 
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(5, N_ROWS)).astype(np.float32)
-    y = (
-        np.cos(2.13 * X[0])
-        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
-        - 0.3 * np.abs(X[3]) ** 1.5
-    ).astype(np.float32)
+    X, y = config3_data(N_ROWS)
     options = Options(
         binary_operators=["+", "-", "*", "/"],
         unary_operators=["cos", "exp", "abs"],

@@ -1,0 +1,118 @@
+"""Kernel roofline measurement: TRUE execution time of the fused loss kernel.
+
+Measurement methodology (the only reliable one found on the tunneled backend —
+see ROOFLINE_r03.md "measurement pathology"): chain K kernel invocations
+inside ONE jitted dispatch (CSE defeated by perturbing vals per invocation),
+time the dispatch in the SYNC regime (after the first device-to-host copy),
+and fit time-vs-K — the slope is pure kernel execution, the intercept absorbs
+the backend's ~100ms fixed dispatch overhead. `block_until_ready` in the
+async regime returns without waiting on this backend and must not be trusted
+for timing.
+
+Emits one JSON line: kernel-true evals/s, ns per (tree,slot), and the
+utilization decomposition against the pure-vector floor and VPU peak.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+P, R, N = 10_240, 10_240, 20
+V5E_VPU_FLOPS = 3.8e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        C_TILE,
+        P_TILE_LOSS,
+        _loss_pallas,
+        _reshape_rows,
+        pack_flat_fused,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, R)).astype(np.float32)
+    y = np.cos(X[0]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        maxsize=N,
+        save_to_file=False,
+    )
+    opset, loss_elem = opts.operators, opts.loss
+    trees = Population.random_trees(P, opts, 5, rng)
+    slots = float(np.mean([len(t.postorder()) for t in trees]))
+    Xr, yr, wr, C, Rr = _reshape_rows(X, y, None)
+    flat = flatten_trees(trees, N)
+    ints, vals = pack_flat_fused(flat, opset)
+
+    def make_chain(K):
+        @jax.jit
+        def fK(ints, vals):
+            acc = jnp.zeros((P,), jnp.float32)
+            for k in range(K):
+                v = vals + (k + 1) * 1e-7  # defeat CSE between invocations
+                out = _loss_pallas(
+                    ints, v, Xr, yr, wr, opset, loss_elem,
+                    N, P_TILE_LOSS, C_TILE, C, Rr,
+                )
+                acc = acc + jnp.where(jnp.isfinite(out), out, 0.0)
+            return acc
+
+        return fK
+
+    # first readback drops the backend into the sync regime: every timed
+    # np.asarray below then waits for real execution
+    _ = np.asarray(make_chain(1)(ints, vals))
+
+    pts = []
+    for K in (1, 2, 4, 8):
+        fK = make_chain(K)
+        _ = np.asarray(fK(ints, vals))  # compile
+        reps = 6
+        t0 = time.time()
+        for _i in range(reps):
+            _ = np.asarray(fK(ints, vals))
+        pts.append((K, (time.time() - t0) / reps))
+
+    ks = np.array([p[0] for p in pts], float)
+    ts = np.array([p[1] for p in pts], float)
+    A = np.vstack([ks, np.ones_like(ks)]).T
+    slope, intercept = np.linalg.lstsq(A, ts, rcond=None)[0]
+
+    evals_per_sec = P / slope
+    ns_per_slot = slope / P / slots * 1e9
+    # pure-vector floor: 10 vregs (one (8,1280) f32 tile op) per (tree, slot)
+    # at 1 vreg-op/cycle, 940 MHz
+    vector_floor_s = P * slots * 10 / 0.94e9
+    useful_flops = evals_per_sec * slots * R
+    print(
+        json.dumps(
+            {
+                "metric": "kernel_roofline",
+                "kernel_true_evals_per_sec": round(evals_per_sec, 0),
+                "kernel_exec_ms_per_sweep": round(slope * 1000, 2),
+                "dispatch_overhead_ms": round(intercept * 1000, 1),
+                "ns_per_tree_slot": round(ns_per_slot, 1),
+                "avg_nodes_per_tree": round(slots, 2),
+                "vector_floor_ms_per_sweep": round(vector_floor_s * 1000, 2),
+                "scalar_control_gap": round(slope / vector_floor_s, 1),
+                "vpu_utilization_true": round(useful_flops / V5E_VPU_FLOPS, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,39 +46,35 @@ def _run(config_name, scheduler, X, y, opt_kwargs, niterations, seed):
     }
 
 
-def config1_problem():
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(2, 100)).astype(np.float32)
-    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
-    kwargs = dict(
-        binary_operators=["+", "-", "*"],
-        unary_operators=["cos"],
-        populations=20,
-        maxsize=20,
-    )
-    return X, y, kwargs
+def _run_wall_matched(config_name, X, y, opt_kwargs, timeout_s, seed):
+    """Device leg with the lockstep leg's wall-clock budget as its timeout —
+    the matched-WALL-CLOCK comparison (the matched-iteration legs above are
+    the matched-BUDGET one)."""
+    from symbolicregression_jl_tpu import Options, equation_search
 
-
-def config3_problem():
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(5, 10_000)).astype(np.float32)
-    y = (
-        np.cos(2.13 * X[0])
-        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
-        - 0.3 * np.abs(X[3]) ** 1.5
-    ).astype(np.float32)
-    kwargs = dict(
-        binary_operators=["+", "-", "*", "/"],
-        unary_operators=["cos", "exp", "abs"],
-        populations=100,
-        population_size=100,
-        ncycles_per_iteration=550,
-        maxsize=20,
+    options = Options(
+        save_to_file=False, seed=seed, scheduler="device",
+        timeout_in_seconds=timeout_s, **opt_kwargs,
     )
-    return X, y, kwargs
+    t0 = time.time()
+    res = equation_search(X, y, options=options, niterations=100, verbosity=0)
+    wall = time.time() - t0
+    front = _frontier(res, options)
+    return {
+        "config": config_name,
+        "scheduler": "device",
+        "seed": seed,
+        "note": f"wall-clock matched to the lockstep leg (timeout {timeout_s:.0f}s)",
+        "wall_s": round(wall, 1),
+        "best_loss": min(front.values()),
+        "num_evals": round(res.num_evals, 0),
+        "front": front,
+    }
 
 
 def main(full: bool = True):
+    from bench_problems import config1_problem, config3_problem
+
     results = []
     seeds = [0, 1, 2]
 
@@ -95,10 +91,17 @@ def main(full: bool = True):
             r = _run("3_bench_10k_100x100", sched, X, y, kw, niterations=4, seed=0)
             print(json.dumps(r), flush=True)
             results.append(r)
+        lock_wall = next(
+            r["wall_s"] for r in results
+            if r["config"] == "3_bench_10k_100x100" and r["scheduler"] == "lockstep"
+        )
+        r = _run_wall_matched("3_bench_10k_100x100", X, y, kw, lock_wall, seed=0)
+        print(json.dumps(r), flush=True)
+        results.append(r)
 
     # summary: per config, best loss of each engine across seeds + the ratio
     summary = {"metric": "device_vs_lockstep_parity"}
-    for config in {r["config"] for r in results}:
+    for config in sorted({r["config"] for r in results}):
         dev = [r["best_loss"] for r in results
                if r["config"] == config and r["scheduler"] == "device"]
         lock = [r["best_loss"] for r in results
@@ -109,8 +112,12 @@ def main(full: bool = True):
             "lockstep_best_loss": lock_best,
             "device_per_seed": dev,
             "lockstep_per_seed": lock,
+            "device_wall_s": [r["wall_s"] for r in results
+                              if r["config"] == config and r["scheduler"] == "device"],
+            "lockstep_wall_s": [r["wall_s"] for r in results
+                                if r["config"] == config and r["scheduler"] == "lockstep"],
             # +1e-12: both engines hit exact float32 zero on recoverable targets
-            "log10_ratio": round(
+            "log10_ratio_best": round(
                 float(np.log10((dev_best + 1e-12) / (lock_best + 1e-12))), 2
             ),
         }

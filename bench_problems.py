@@ -1,0 +1,61 @@
+"""Shared planted problems for the benchmark suite.
+
+One definition imported by bench.py, bench_quality.py and bench_parity_ab.py
+so cross-benchmark numbers stay comparable — the A/B's validity depends on
+every benchmark seeing byte-identical data (same seed, formula, dtype).
+
+Config 1 — README low-level example (/root/reference/example.jl:1-27).
+Config 3 — the reference benchmark-suite config scaled to the north star
+(/root/reference/benchmark/benchmarks.jl:9-79): 10k rows x 5 features,
+noisy non-recoverable target outside the operator basis by construction.
+"""
+
+import numpy as np
+
+__all__ = ["config1_problem", "config3_data", "config3_problem"]
+
+
+def config1_problem(holdout_rows: int = 0):
+    """y = 2cos(x2) + x1^2 - 2 on randn(2, 100). With holdout_rows > 0 also
+    returns a held-out set drawn from the SAME rng stream (preserves the
+    draw sequence bench_quality has always used)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 100)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    kwargs = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=20,
+        maxsize=20,
+    )
+    if holdout_rows:
+        Xh = rng.normal(size=(2, holdout_rows)).astype(np.float32)
+        yh = 2 * np.cos(Xh[1]) + Xh[0] ** 2 - 2
+        return X, y, Xh, yh, kwargs
+    return X, y, kwargs
+
+
+def config3_data(n_rows: int = 10_000, n_features: int = 5, rng=None):
+    """``rng``: pass a generator to keep drawing from an existing stream
+    (bench.py draws its random population from the same stream after X)."""
+    rng = np.random.default_rng(0) if rng is None else rng
+    X = rng.normal(size=(n_features, n_rows)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    return X, y
+
+
+def config3_problem():
+    X, y = config3_data()
+    kwargs = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=100,
+        population_size=100,
+        ncycles_per_iteration=550,
+        maxsize=20,
+    )
+    return X, y, kwargs

@@ -35,23 +35,11 @@ def _pareto_rows(res, options):
 
 
 def config1(scheduler: str):
+    from bench_problems import config1_problem
     from symbolicregression_jl_tpu import Options, equation_search
 
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(2, 100)).astype(np.float32)
-    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
-    Xh = rng.normal(size=(2, 500)).astype(np.float32)  # held out
-    yh = 2 * np.cos(Xh[1]) + Xh[0] ** 2 - 2
-
-    options = Options(
-        binary_operators=["+", "-", "*"],
-        unary_operators=["cos"],
-        populations=20,
-        maxsize=20,
-        save_to_file=False,
-        seed=0,
-        scheduler=scheduler,
-    )
+    X, y, Xh, yh, kwargs = config1_problem(holdout_rows=500)
+    options = Options(save_to_file=False, seed=0, scheduler=scheduler, **kwargs)
     t0 = time.time()
     res = equation_search(X, y, options=options, niterations=20, verbosity=0)
     wall = time.time() - t0
@@ -74,27 +62,12 @@ def config1(scheduler: str):
 
 
 def config3(scheduler: str, niterations: int = 12):
+    from bench_problems import config3_problem
     from symbolicregression_jl_tpu import Options, equation_search
 
-    rng = np.random.default_rng(0)
-    X = rng.normal(size=(5, 10_000)).astype(np.float32)
-    y = (
-        np.cos(2.13 * X[0])
-        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
-        - 0.3 * np.abs(X[3]) ** 1.5
-    ).astype(np.float32)
     # the reference benchmark adds 20% mult. noise; keep it deterministic here
-    options = Options(
-        binary_operators=["+", "-", "*", "/"],
-        unary_operators=["cos", "exp", "abs"],
-        populations=100,
-        population_size=100,
-        ncycles_per_iteration=550,
-        maxsize=20,
-        save_to_file=False,
-        seed=0,
-        scheduler=scheduler,
-    )
+    X, y, kwargs = config3_problem()
+    options = Options(save_to_file=False, seed=0, scheduler=scheduler, **kwargs)
     t0 = time.time()
     res = equation_search(X, y, options=options, niterations=niterations, verbosity=0)
     wall = time.time() - t0

@@ -206,7 +206,7 @@ def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig, axis=Non
     import jax.numpy as jnp
     from jax import lax
 
-    from ..ops.constant_opt import _bfgs_single, _tree_loss_fn
+    from ..ops.constant_opt import _bfgs_single, remat_tree_loss
     from ..ops.interp import _Structure
 
     I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
@@ -235,14 +235,7 @@ def _make_const_opt_fn(X, y, weights, options: Options, cfg: EvoConfig, axis=Non
     yd = jnp.asarray(y, jnp.float32)
     has_w = weights is not None
     wd = jnp.asarray(weights, jnp.float32) if has_w else jnp.zeros((), jnp.float32)
-    _base_loss = _tree_loss_fn(opset, loss_elem)
-    # remat: recompute the interpreter in the backward pass instead of saving
-    # per-branch residuals — trades ~2x FLOPs for ~n_ops x less live memory,
-    # which is what bounds the BFGS batch size here
-    _ck = jax.checkpoint(lambda v, s: _base_loss(v, s, Xd, yd, wd, has_w))
-
-    def loss_fn(v, s, X_, y_, w_, hw_):
-        return _ck(v, s)
+    loss_fn = remat_tree_loss(opset, loss_elem, Xd, yd, wd, has_w)
 
     def const_opt(state: EvoState) -> EvoState:
         key, ii, pp, val0, mask, starts = _select_and_jitter(

@@ -187,6 +187,22 @@ def _neldermead_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: in
     return verts[best], fvals[best]
 
 
+def remat_tree_loss(opset, loss_elem, X, y, w, has_w):
+    """Interpreter loss closure with rematerialization: recompute the forward
+    sweep in the backward pass instead of saving per-branch residuals —
+    trades ~2x FLOPs for ~n_ops x less live memory, which is what bounds the
+    BFGS batch size. Shared by _optimize_batch and the device engine's
+    non-Pallas const-opt fallback (models/device_search.py); keeps the
+    6-arg _bfgs_single signature, ignoring the already-closed-over args."""
+    raw = _tree_loss_fn(opset, loss_elem)
+    ck = jax.checkpoint(lambda v, s: raw(v, s, X, y, w, has_w))
+
+    def loss_fn(v, s, X_, y_, w_, hw_):
+        return ck(v, s)
+
+    return loss_fn
+
+
 @functools.partial(
     jax.jit, static_argnames=("opset", "loss_elem", "iters", "has_w", "algorithm")
 )
@@ -206,12 +222,7 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
     engine's fallback (models/device_search.py)."""
     import os
 
-    loss_fn_raw = _tree_loss_fn(opset, loss_elem)
-    _ck = jax.checkpoint(lambda v, s: loss_fn_raw(v, s, X, y, w, has_w))
-
-    def loss_fn(v, s, X_, y_, w_, hw_):
-        return _ck(v, s)
-
+    loss_fn = remat_tree_loss(opset, loss_elem, X, y, w, has_w)
     structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
     mask = flat.kind == KIND_CONST  # [P, N]
     main = _bfgs_single if algorithm == "BFGS" else _neldermead_single

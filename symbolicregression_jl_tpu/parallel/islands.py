@@ -153,10 +153,11 @@ def async_search_one_output(
     )
 
     def on_complete(i: int, pop: Population, best_seen: HallOfFame):
-        """Head-side merge (reference main loop :896-1006). The lock guards
-        only the shared-state mutations; CSV writes and progress rendering
-        run on a hof SNAPSHOT outside it, so at 100+ islands completions
-        serialize on microseconds of merging, not file IO."""
+        """Head-side merge (reference main loop :896-1006). Runs ONLY on the
+        dispatch-loop thread; the lock exists for the work_unit threads that
+        read pops/stats, so it guards just the shared-state mutations — CSV
+        writes and progress rendering happen after release (hof is mutated
+        nowhere else, so reading it lock-free here is safe)."""
         t_head = time.time()
         with lock:
             pops[i] = pop
@@ -180,21 +181,17 @@ def async_search_one_output(
                     migrate(
                         frontier, pops[i], options, options.fraction_replaced_hof, rng
                     )
-            hof_snapshot = hof.copy()
-
         if output_file and options.save_to_file:
-            # atomic-replace CSV (export_csv) — concurrent snapshots may race
-            # on recency but never corrupt the file
-            save_hall_of_fame(output_file, hof_snapshot, options, dataset.variable_names)
+            save_hall_of_fame(output_file, hof, options, dataset.variable_names)
         reporter.update(
-            hof_snapshot, scorer.num_evals, dataset.variable_names,
+            hof, scorer.num_evals, dataset.variable_names,
             y_variable_name=dataset.y_variable_name,
         )
         # stop conditions (reference :1053-1060); stop_reason writes are
         # idempotent, so no lock is needed around them
         if early_stop is not None and any(
             early_stop(m.loss, m.get_complexity(options))
-            for m in hof_snapshot.pareto_frontier()
+            for m in hof.pareto_frontier()
         ):
             stop_reason[0] = "early_stop"
         if (
