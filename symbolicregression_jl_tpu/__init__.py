@@ -24,6 +24,7 @@ from .ops import (
     flatten_trees,
     resolve_operators,
 )
+from .utils.checkpoint import load_saved_state
 
 __version__ = "0.1.0"
 
@@ -48,5 +49,6 @@ __all__ = [
     "eval_trees_with_ok",
     "flatten_trees",
     "resolve_operators",
+    "load_saved_state",
     "__version__",
 ]

@@ -120,6 +120,7 @@ def build_evo_config(
         topn=min(options.topn, P),
         niterations=niterations,
         warmup_maxsize_by=options.warmup_maxsize_by,
+        mutation_attempts=int(options.device_mutation_attempts),
     )
 
 
@@ -986,6 +987,13 @@ def device_search_one_output(
                 bl, np.isfinite(bl), bn, flds, cfg, options
             ):
                 hof.update(m, options)
+
+    # final CSV write AFTER the population decode: the decode folds the last
+    # const-opt's improvements (absent from the bs-frontier readbacks) into
+    # the hall of fame, and the returned frontier must match the saved file —
+    # load_saved_state round-trips depend on it
+    if output_file and options.save_to_file and head:
+        save_hall_of_fame(output_file, hof, options, dataset.variable_names)
 
     result = SearchResult(
         hall_of_fame=hof,

@@ -66,9 +66,11 @@ class HallOfFame:
                 best = m.loss
         return out
 
-    def format(self, options, variable_names=None) -> list[dict]:
+    def format(self, options, variable_names=None, precision=None) -> list[dict]:
         """Frontier rows with the -dlog(loss)/dcomplexity score
-        (reference: format_hall_of_fame, /root/reference/src/HallOfFame.jl:155-198)."""
+        (reference: format_hall_of_fame, /root/reference/src/HallOfFame.jl:155-198).
+        ``precision``: constant digits (default options.print_precision; the
+        CSV writer passes 17 so checkpoints round-trip float64 exactly)."""
         frontier = self.pareto_frontier()
         rows = []
         prev_loss, prev_c = None, None
@@ -92,7 +94,13 @@ class HallOfFame:
                     "loss": loss,
                     "score": max(score, 0.0),
                     "equation": m.tree.string_tree(
-                        options.operators, variable_names, precision=options.print_precision
+                        options.operators,
+                        variable_names,
+                        precision=(
+                            precision
+                            if precision is not None
+                            else options.print_precision
+                        ),
                     ),
                     "member": m,
                 }

@@ -108,6 +108,12 @@ class EvoConfig:
     topn: int
     niterations: int
     warmup_maxsize_by: float
+    # bounded in-jit mutation retries per event (reference: <=10 host-side,
+    # /root/reference/src/Mutate.jl:247-266). Default 1, matching
+    # Options.device_mutation_attempts: each extra attempt unrolls into the
+    # compiled event program and was measured 2.2x slower end-to-end with no
+    # recovery-rate gain, so retries are opt-in.
+    mutation_attempts: int = 1
 
 
 class EvoState(NamedTuple):
@@ -594,12 +600,45 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
 
     sizes1 = jax.vmap(subtree_sizes)(parent1)
     sizes2 = jax.vmap(subtree_sizes)(parent2)
-    mut_kinds = jax.vmap(choose_kind)(jax.random.split(k_kind, L), parent1)
-    mutated = jax.vmap(
-        lambda k, t, m, sz: _apply_mutation(
-            k, t, m, cfg, curmaxsize, temperature, sz
-        )
-    )(jax.random.split(k_mut, L), parent1, mut_kinds, sizes1)
+
+    def _mutate_once(kk, km):
+        kinds_a = jax.vmap(choose_kind)(jax.random.split(kk, L), parent1)
+        return jax.vmap(
+            lambda k, t, m, sz: _apply_mutation(
+                k, t, m, cfg, curmaxsize, temperature, sz
+            )
+        )(jax.random.split(km, L), parent1, kinds_a, sizes1)
+
+    if cfg.mutation_attempts <= 1:
+        mutated = _mutate_once(k_kind, k_mut)
+    else:
+        # bounded retries: re-draw kind + mutation for lanes whose earlier
+        # attempts produced an invalid candidate — the in-jit analogue of the
+        # reference's <=10 constraint-checked attempts
+        # (/root/reference/src/Mutate.jl:247-266). Each attempt unrolls into
+        # the program; opt-in via Options.device_mutation_attempts.
+        def _valid(c):
+            depth = jax.vmap(tree_depth)(c)
+            return (c.length <= jnp.minimum(curmaxsize, N)) & (
+                depth <= cfg.maxdepth
+            )
+
+        mutated = parent1
+        mut_ok = jnp.zeros((L,), bool)
+        for attempt in range(cfg.mutation_attempts):
+            mutated_a = _mutate_once(
+                jax.random.fold_in(k_kind, attempt),
+                jax.random.fold_in(k_mut, attempt),
+            )
+            take = _valid(mutated_a) & ~mut_ok
+            mutated = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(
+                    take.reshape((L,) + (1,) * (a.ndim - 1)), a, b
+                ),
+                mutated_a,
+                mutated,
+            )
+            mut_ok = mut_ok | take
 
     # crossover path (children pair)
     xo1, xo2 = jax.vmap(lambda k, a, b, sa, sb: _crossover(k, a, b, cfg, sa, sb))(

@@ -177,6 +177,15 @@ class Options:
     # — the reference's analogue is one Julia Task per population,
     # /root/reference/src/SearchUtils.jl:121-122)
     async_workers: int | None = None
+    # device engine: bounded in-jit mutation retries per event (invalid
+    # candidates re-draw kind + mutation instead of falling back to the
+    # parent). The host engines always use the reference's 10
+    # (/root/reference/src/Mutate.jl:247-266); on device each attempt is
+    # UNROLLED into the compiled program. Default 1: measured on-chip,
+    # attempts=3 made config-1 searches 2.2x slower with no recovery-rate
+    # gain (seed-level noise dominates), so the reference's retry semantics
+    # are opt-in here.
+    device_mutation_attempts: int = 1
     # compile the scoring/const-opt/iteration programs before the timed
     # loop so iteration 1 runs at steady-state speed (the reference
     # precompiles its workload at package build,
@@ -208,6 +217,8 @@ class Options:
             )
         if self.async_workers is not None and self.async_workers < 1:
             raise ValueError("async_workers must be >= 1 (or None for auto)")
+        if self.device_mutation_attempts < 1:
+            raise ValueError("device_mutation_attempts must be >= 1")
         if self.optimizer_algorithm not in ("BFGS", "NelderMead"):
             raise ValueError(
                 f"unsupported optimizer_algorithm {self.optimizer_algorithm!r}; "

@@ -243,6 +243,9 @@ def _search_one_output(
 
     stdin_reader.close()
     recorder.dump()
+    if output_file and options.save_to_file:
+        # final write: the saved file must match the returned frontier
+        save_hall_of_fame(output_file, hof, options, dataset.variable_names)
     result = SearchResult(
         hall_of_fame=hof,
         populations=pops,

@@ -1,0 +1,175 @@
+"""Resume a search from a saved hall-of-fame CSV.
+
+The reference's CSV output is write-only — its only resume path is the
+in-memory ``saved_state`` object (/root/reference/src/SearchUtils.jl:410-450
+writes, nothing reads). This module closes that gap: ``load_saved_state``
+parses the ``Complexity,Loss,Equation`` rows back into trees through the
+sympy bridge (export_sympy.sympy_to_node) and returns a warm-startable
+state. Losses in the file are treated as stale: every scheduler RESCORES
+saved hall-of-fame members against the current dataset on warm start, so a
+checkpoint written against one dataset can seed a search on another.
+
+Equations are parsed by a recursive-descent parser for string_tree's own
+grammar (tree.py:224-253) — exact structural round-trip, no algebraic
+normalization (sympy's sympify rewrites x - y as x + (-1*y), which inflates
+complexity and can push a frontier member past maxsize). Strings the
+grammar does not cover fall back to the sympy bridge.
+"""
+
+from __future__ import annotations
+
+import csv
+import types
+
+__all__ = ["load_saved_state", "parse_equation"]
+
+
+def parse_equation(s: str, opset, variable_names: list[str] | None = None):
+    """Parse a string_tree rendering back into a Node — the exact inverse of
+    tree.Node.string_tree: ``(L <display> R)`` infix binaries,
+    ``name(args...)`` calls, ``-(x)`` for neg, xN / variable-name leaves,
+    %.Ng constants (incl. inf/nan)."""
+    from ..tree import binary, constant, feature, unary
+
+    names = {}
+    if variable_names is not None:
+        names = {name: i for i, name in enumerate(variable_names)}
+    n = len(s)
+    pos = 0
+
+    def error(msg):
+        return ValueError(f"cannot parse equation at {pos}: {msg} in {s!r}")
+
+    def peek():
+        return s[pos] if pos < n else ""
+
+    def expect(ch):
+        nonlocal pos
+        if not s.startswith(ch, pos):
+            raise error(f"expected {ch!r}")
+        pos += len(ch)
+
+    def ident():
+        nonlocal pos
+        start = pos
+        while pos < n and (s[pos].isalnum() or s[pos] == "_"):
+            pos += 1
+        return s[start:pos]
+
+    def number():
+        nonlocal pos
+        start = pos
+        if peek() in "+-":
+            pos += 1
+        if s.startswith("inf", pos) or s.startswith("nan", pos):
+            pos += 3
+            return float(s[start:pos])
+        while pos < n and (s[pos].isdigit() or s[pos] == "."):
+            pos += 1
+        if pos < n and s[pos] in "eE":
+            pos += 1
+            if peek() in "+-":
+                pos += 1
+            while pos < n and s[pos].isdigit():
+                pos += 1
+        return float(s[start:pos])
+
+    def expr():
+        nonlocal pos
+        c = peek()
+        if c == "(":
+            # infix binary: (L <display> R)
+            expect("(")
+            left = expr()
+            expect(" ")
+            op_start = pos
+            while pos < n and s[pos] != " ":
+                pos += 1
+            op_tok = s[op_start:pos]
+            expect(" ")
+            right = expr()
+            expect(")")
+            return binary(opset.binary_index(op_tok), left, right)
+        if c == "-":
+            if s.startswith("-(", pos):  # neg's special rendering
+                pos += 1
+                expect("(")
+                inner = expr()
+                expect(")")
+                return unary(opset.unary_index("neg"), inner)
+            return constant(number())
+        if c.isdigit() or c == ".":
+            return constant(number())
+        name = ident()
+        if not name:
+            raise error("expected a term")
+        if peek() == "(":  # function call: unary or display-less binary
+            expect("(")
+            args = [expr()]
+            while s.startswith(", ", pos):
+                pos += 2
+                args.append(expr())
+            expect(")")
+            if len(args) == 1:
+                return unary(opset.unary_index(name), args[0])
+            if len(args) == 2:
+                return binary(opset.binary_index(name), args[0], args[1])
+            raise error(f"{name} takes {len(args)} args")
+        if name in names:
+            return feature(names[name])
+        if name.startswith("x") and name[1:].isdigit():
+            return feature(int(name[1:]) - 1)
+        if name in ("inf", "nan"):
+            return constant(float(name))
+        raise error(f"unknown symbol {name!r}")
+
+    out = expr()
+    if pos != n:
+        raise error("trailing characters")
+    return out
+
+
+def load_saved_state(
+    path: str, options, variable_names: list[str] | None = None
+):
+    """Parse a hall-of-fame CSV (save_hall_of_fame format) into an object
+    accepted by ``equation_search(saved_state=...)``: populations are left
+    empty (schedulers fill with fresh random members) and the hall of fame
+    seeds the search, rescored against the live dataset."""
+    from ..complexity import compute_complexity
+    from ..export_sympy import sympy_to_node
+    from ..models.hall_of_fame import HallOfFame
+    from ..models.pop_member import PopMember
+
+    hof = HallOfFame(options.maxsize)
+    with open(path, newline="") as f:
+        reader = csv.DictReader(f)
+        fields = set(reader.fieldnames or ())
+        if not {"Loss", "Equation"} <= fields:
+            raise ValueError(
+                f"{path!r} is not a hall-of-fame CSV "
+                "(expected a Complexity,Loss,Equation header)"
+            )
+        for row in reader:
+            try:
+                tree = parse_equation(
+                    row["Equation"], options.operators, variable_names
+                )
+            except (ValueError, KeyError):
+                # not our grammar (hand-edited file / foreign tool): the
+                # sympy bridge accepts general infix ('^' is sympy XOR)
+                tree = sympy_to_node(
+                    row["Equation"].replace("^", "**"),
+                    options.operators,
+                    variable_names,
+                )
+            loss = float(row["Loss"])
+            comp = compute_complexity(tree, options)
+            # score is recomputed on warm-start rescore; loss is a stale hint
+            m = PopMember(tree, loss, loss, complexity=comp)
+            hof.update(m, options)
+
+    return types.SimpleNamespace(
+        hall_of_fame=hof,
+        populations=[],
+    )

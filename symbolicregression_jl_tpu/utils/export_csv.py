@@ -13,7 +13,9 @@ __all__ = ["save_hall_of_fame"]
 
 
 def save_hall_of_fame(path: str, hof, options, variable_names=None) -> None:
-    rows = hof.format(options, variable_names)
+    # precision 17: constants round-trip float64 exactly, so a saved CSV can
+    # seed a bit-faithful warm start (utils/checkpoint.load_saved_state)
+    rows = hof.format(options, variable_names, precision=17)
     lines = ["Complexity,Loss,Equation"]
     for r in rows:
         eq = r["equation"].replace('"', '""')

@@ -121,3 +121,23 @@ def test_device_search_weighted():
         X, y, weights=w, options=_opts(), niterations=2, verbosity=0
     )
     assert np.isfinite(res.best().loss)
+
+
+def test_device_mutation_attempts_honored():
+    """device_mutation_attempts > 1 unrolls bounded in-jit mutation retries
+    (reference: <=10 attempts, /root/reference/src/Mutate.jl:247-266) and
+    must still produce a valid, improving search."""
+    X, y = _problem()
+    res = equation_search(
+        X, y,
+        options=_opts(ncycles_per_iteration=20, device_mutation_attempts=2),
+        niterations=2, verbosity=0,
+    )
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+    assert all(
+        1 <= m.tree.count_nodes() <= 14
+        for p in res.populations for m in p.members
+    )
+    with pytest.raises(ValueError, match="device_mutation_attempts"):
+        Options(binary_operators=["+"], save_to_file=False,
+                device_mutation_attempts=0)
