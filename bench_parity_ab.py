@@ -127,14 +127,19 @@ def main(full: bool = True):
         wall_matched = [r for r in results
                         if r["config"] == config and "note" in r]
         if wall_matched:
-            w = wall_matched[0]
-            entry["device_wall_matched"] = {
-                "best_loss": w["best_loss"],
-                "wall_s": w["wall_s"],
-                "log10_ratio_vs_lockstep": round(
-                    float(np.log10((w["best_loss"] + 1e-12) / (lock_best + 1e-12))), 2
-                ),
-            }
+            entry["device_wall_matched"] = [
+                {
+                    "seed": w.get("seed"),
+                    "best_loss": w["best_loss"],
+                    "wall_s": w["wall_s"],
+                    "log10_ratio_vs_lockstep": round(
+                        float(np.log10(
+                            (w["best_loss"] + 1e-12) / (lock_best + 1e-12)
+                        )), 2
+                    ),
+                }
+                for w in wall_matched
+            ]
         summary[config] = entry
     print(json.dumps(summary), flush=True)
 

@@ -49,6 +49,7 @@ from .flat import KIND_BINARY, KIND_CONST, KIND_PAD, KIND_UNARY, KIND_VAR
 from .treeops import (
     Tree,
     extract_block,
+    gather_slots,
     random_tree,
     replace_range,
     subtree_sizes,
@@ -304,29 +305,30 @@ def _swap_operands(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     lenA = sizes[l_root]
     al = l_root - lenA + 1  # A = [al, al+lenA), B = [al+lenA, p)
     j = lax.iota(jnp.int32, N)
-    inA = (j >= al) & (j < al + lenA)
-    inB = (j >= al + lenA) & (j < p)
     # new layout: B first (shift left by lenA), then A (shift right by lenB)
     src = jnp.clip(jnp.where(j < al + lenB, j + lenA, j - lenB), 0, N - 1)
     use_move = (j >= al) & (j < p)
 
-    def mv(arr):
-        return jnp.where(use_move, arr[src], arr)
+    # ONE MXU one-hot gather for all six fields (per-lane dynamic gathers
+    # are the engine's dominant cost — see treeops.gather_slots)
+    g_kind, g_op, g_lhs, g_rhs, g_feat, g_val = gather_slots(tree, src)
 
-    def mv_ptr(arr):
-        c = arr[src]
+    def mv(g, orig):
+        return jnp.where(use_move, g, orig)
+
+    def mv_ptr(c, orig):
         cin_a = (c >= al) & (c < al + lenA)
         c2 = jnp.where(cin_a, c + lenB, jnp.where((c >= al + lenA) & (c < p), c - lenA, c))
-        return jnp.where(use_move, c2, arr)
+        return jnp.where(use_move, c2, orig)
 
-    kind = mv(tree.kind)
+    kind = mv(g_kind, tree.kind)
     new = tree._replace(
         kind=kind,
-        op=mv(tree.op),
-        lhs=jnp.where(kind >= KIND_UNARY, mv_ptr(tree.lhs), 0),
-        rhs=jnp.where(kind == KIND_BINARY, mv_ptr(tree.rhs), 0),
-        feat=mv(tree.feat),
-        val=jnp.where(use_move, tree.val[src], tree.val),
+        op=mv(g_op, tree.op),
+        lhs=jnp.where(kind >= KIND_UNARY, mv_ptr(g_lhs, tree.lhs), 0),
+        rhs=jnp.where(kind == KIND_BINARY, mv_ptr(g_rhs, tree.rhs), 0),
+        feat=mv(g_feat, tree.feat),
+        val=jnp.where(use_move, g_val, tree.val),
     )
     # fix the chosen node's own child pointers (it did not move)
     new_lhs = new.lhs.at[p].set(al + lenB - 1)  # old B root, now first block

@@ -32,6 +32,7 @@ __all__ = [
     "replace_range",
     "random_tree",
     "tree_depth",
+    "gather_slots",
 ]
 
 
@@ -53,6 +54,64 @@ class Tree(NamedTuple):
 
 def _iota(n):
     return lax.iota(jnp.int32, n)
+
+
+def gather_slots(tree: Tree, src: jax.Array):
+    """All six field arrays gathered at per-slot indices ``src`` [N], as a
+    one-hot MXU contraction.
+
+    Why: a per-lane dynamic gather (``arr[src]`` under vmap) lowers to
+    O(N^2) compare-selects on the VPU — measured ~230us per 6-field gather
+    at [900, 24], which made tree surgery the device engine's dominant cost
+    (ROOFLINE_r03.md). The same permutation as an (N, N) one-hot matmul
+    rides the MXU below measurement noise. precision='highest' keeps the
+    f32 val field bit-exact (one-hot rows have a single 1; bf16x3
+    decomposition reproduces f32 exactly).
+
+    Non-finite constants (a mutated constant can legitimately reach inf
+    while its tree's loss stays finite) would poison the contraction —
+    0 * inf = NaN across the whole row — so val enters the matmul
+    sanitized and non-finite entries ride along as a small integer code,
+    reconstructed after the gather.
+
+    Returns (kind, op, lhs, rhs, feat, val) gathered arrays."""
+    N = tree.n_slots
+    oh = (src[:, None] == _iota(N)[None, :]).astype(jnp.float32)  # [N, N]
+    finite = jnp.isfinite(tree.val)
+    val_clean = jnp.where(finite, tree.val, 0.0)
+    # 0 finite, 1 nan, 2 +inf, 3 -inf — exact in f32
+    nf_code = jnp.where(
+        finite,
+        0,
+        jnp.where(jnp.isnan(tree.val), 1, jnp.where(tree.val > 0, 2, 3)),
+    ).astype(jnp.float32)
+    stacked = jnp.stack(
+        [
+            tree.kind.astype(jnp.float32),
+            tree.op.astype(jnp.float32),
+            tree.lhs.astype(jnp.float32),
+            tree.rhs.astype(jnp.float32),
+            tree.feat.astype(jnp.float32),
+            val_clean,
+            nf_code,
+        ],
+        axis=-1,
+    )  # [N, 7]
+    out = jnp.einsum("nm,mf->nf", oh, stacked, precision="highest")
+    code = out[:, 6].astype(jnp.int32)
+    val = jnp.where(
+        code == 0,
+        out[:, 5],
+        jnp.where(code == 1, jnp.nan, jnp.where(code == 2, jnp.inf, -jnp.inf)),
+    )
+    return (
+        out[:, 0].astype(jnp.int32),
+        out[:, 1].astype(jnp.int32),
+        out[:, 2].astype(jnp.int32),
+        out[:, 3].astype(jnp.int32),
+        out[:, 4].astype(jnp.int32),
+        val,
+    )
 
 
 def subtree_sizes(tree: Tree) -> jax.Array:
@@ -104,21 +163,19 @@ def extract_block(tree: Tree, a, b) -> Tree:
     m = b - a
     inside = j < m
 
-    def take(arr, fill=0):
-        return jnp.where(inside, arr[src], fill)
-
-    kind = take(tree.kind, KIND_PAD)
+    g_kind, g_op, g_lhs, g_rhs, g_feat, g_val = gather_slots(tree, src)
+    kind = jnp.where(inside, g_kind, KIND_PAD)
     return Tree(
         kind=kind,
-        op=take(tree.op),
+        op=jnp.where(inside, g_op, 0),
         lhs=jnp.where(
-            inside & (kind >= KIND_UNARY), jnp.maximum(tree.lhs[src] - a, 0), 0
+            inside & (kind >= KIND_UNARY), jnp.maximum(g_lhs - a, 0), 0
         ),
         rhs=jnp.where(
-            inside & (kind == KIND_BINARY), jnp.maximum(tree.rhs[src] - a, 0), 0
+            inside & (kind == KIND_BINARY), jnp.maximum(g_rhs - a, 0), 0
         ),
-        feat=take(tree.feat),
-        val=jnp.where(inside, tree.val[src], 0.0),
+        feat=jnp.where(inside, g_feat, 0),
+        val=jnp.where(inside, g_val, 0.0),
         length=m.astype(jnp.int32),
     )
 
@@ -146,34 +203,36 @@ def replace_range(tree: Tree, a, b, mat: Tree) -> Tree:
     src_tree = jnp.clip(jnp.where(reg_pre, j, j - shift), 0, N - 1)
     src_mat = jnp.clip(j - a, 0, N - 1)
 
+    t_kind, t_op, t_lhs, t_rhs, t_feat, t_val = gather_slots(tree, src_tree)
+    m_kind, m_op, m_lhs, m_rhs, m_feat, m_val = gather_slots(mat, src_mat)
+
     def pick(tree_arr, mat_arr, fill):
         return jnp.where(
             reg_mat,
-            mat_arr[src_mat],
-            jnp.where(reg_pre | reg_post, tree_arr[src_tree], fill),
+            mat_arr,
+            jnp.where(reg_pre | reg_post, tree_arr, fill),
         )
 
-    kind = pick(tree.kind, mat.kind, KIND_PAD)
-    op = pick(tree.op, mat.op, 0)
-    feat = pick(tree.feat, mat.feat, 0)
-    val = pick(tree.val, mat.val, 0.0)
+    kind = pick(t_kind, m_kind, KIND_PAD)
+    op = pick(t_op, m_op, 0)
+    feat = pick(t_feat, m_feat, 0)
+    val = pick(t_val, m_val, 0.0)
 
-    def remap_ptr(ptr_tree, ptr_mat):
-        c = ptr_tree[src_tree]
+    def remap_ptr(c, ptr_mat):
         c_post = jnp.where(c < a, c, jnp.where(c == b - 1, a + m - 1, c + shift))
         return jnp.where(
             reg_mat,
-            ptr_mat[src_mat] + a,
+            ptr_mat + a,
             jnp.where(reg_pre, c, jnp.where(reg_post, c_post, 0)),
         )
 
     # canonical form: pointer fields are 0 on non-operator slots (keeps
     # structural comparisons exact; no consumer reads them there)
     lhs = jnp.where(
-        kind >= KIND_UNARY, jnp.clip(remap_ptr(tree.lhs, mat.lhs), 0, N - 1), 0
+        kind >= KIND_UNARY, jnp.clip(remap_ptr(t_lhs, m_lhs), 0, N - 1), 0
     )
     rhs = jnp.where(
-        kind == KIND_BINARY, jnp.clip(remap_ptr(tree.rhs, mat.rhs), 0, N - 1), 0
+        kind == KIND_BINARY, jnp.clip(remap_ptr(t_rhs, m_rhs), 0, N - 1), 0
     )
     return Tree(kind, op, lhs, rhs, feat, val, new_len.astype(jnp.int32))
 

@@ -240,6 +240,7 @@ def async_search_one_output(
                     on_complete(idx, pop, best_seen)
                 break
 
+    iteration_seconds = time.time() - start_time
     stdin_reader.close()
     recorder.dump()
     result = SearchResult(
@@ -250,4 +251,5 @@ def async_search_one_output(
         num_evals=scorer.num_evals,
     )
     result.stop_reason = stop_reason[0]
+    result.iteration_seconds = iteration_seconds
     return result

@@ -241,6 +241,7 @@ def _search_one_output(
             stop_reason = "user_quit"
             break
 
+    iteration_seconds = time.time() - start_time
     stdin_reader.close()
     recorder.dump()
     if output_file and options.save_to_file:
@@ -253,6 +254,7 @@ def _search_one_output(
         options=options,
         num_evals=scorer.num_evals,
     )
+    result.iteration_seconds = iteration_seconds
     result.stop_reason = stop_reason
     return result
 

@@ -112,3 +112,33 @@ def test_replace_range_with_random_material():
         assert int(t2.length) == newL
         node = unflatten_tree(_to_ft(t2), 0)  # structural validity
         assert node.count_nodes() == newL
+
+
+def test_gather_slots_preserves_nonfinite_constants():
+    """A tree holding an inf/nan constant must gather cleanly: the one-hot
+    MXU contraction would otherwise turn 0*inf into NaN across EVERY output
+    slot (regression; ops/treeops.gather_slots)."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.ops.treeops import Tree, gather_slots
+
+    N = 8
+    val = jnp.asarray(
+        [1.5, np.inf, -np.inf, np.nan, 2.5, 0.0, -3.5, 4.0], jnp.float32
+    )
+    tree = Tree(
+        kind=jnp.zeros((N,), jnp.int32),
+        op=jnp.zeros((N,), jnp.int32),
+        lhs=jnp.zeros((N,), jnp.int32),
+        rhs=jnp.zeros((N,), jnp.int32),
+        feat=jnp.zeros((N,), jnp.int32),
+        val=val,
+        length=jnp.asarray(N, jnp.int32),
+    )
+    src = jnp.asarray([7, 6, 5, 4, 3, 2, 1, 0], jnp.int32)
+    out = jax.jit(gather_slots)(tree, src)[5]
+    want = np.asarray(val)[::-1]
+    got = np.asarray(out)
+    both_nan = np.isnan(want) & np.isnan(got)
+    assert ((got == want) | both_nan).all(), got
