@@ -96,6 +96,55 @@ class SRRegressor:
     def _make_options(self) -> Options:
         return Options(**{k: getattr(self, k) for k in self._option_kwargs})
 
+    @classmethod
+    def from_file(
+        cls,
+        path,
+        *,
+        variable_names: list[str] | None = None,
+        niterations: int = 10,
+        verbosity: int = 0,
+        selection_method: Callable | None = None,
+        **option_kwargs: Any,
+    ):
+        """Restore an estimator from hall-of-fame CSV checkpoint(s) written
+        by a previous fit (``save_to_file`` / ``output_file``) — the
+        PySR-style resume path; the reference ecosystem's ``from_file``
+        counterpart (its core CSV is write-only). ``option_kwargs`` must
+        recreate the operator set the file was written with.
+
+        ``predict`` / ``equations_`` / ``full_report`` work immediately on
+        the restored frontier; a subsequent ``fit`` warm-starts from it
+        (losses are rescored against the new data). Multitarget: pass one
+        path per output (the ``{base}.out{j}`` files)."""
+        import os
+
+        from .utils.checkpoint import load_saved_state
+
+        option_kwargs.pop("warm_start", None)  # from_file always warm-starts
+        model = cls(
+            niterations=niterations,
+            verbosity=verbosity,
+            selection_method=selection_method,
+            warm_start=True,
+            **option_kwargs,
+        )
+        options = model._make_options()
+        paths = (
+            [path]
+            if isinstance(path, (str, bytes, os.PathLike))
+            else list(path)
+        )
+        if not cls._multitarget and len(paths) != 1:
+            raise ValueError("SRRegressor.from_file takes exactly one path")
+        states = [
+            load_saved_state(p, options, variable_names) for p in paths
+        ]
+        model.state_ = states if cls._multitarget else states[0]
+        model.options_ = options
+        model.feature_names_in_ = variable_names
+        return model
+
     # -- fit / predict -------------------------------------------------------
 
     def _check_y(self, y: np.ndarray) -> np.ndarray:
@@ -130,6 +179,14 @@ class SRRegressor:
         yt = self._check_y(y)
         options = self._make_options()
         saved = self.state_ if (self.warm_start and self.state_ is not None) else None
+        if saved is not None and self._multitarget:
+            n_saved = len(saved) if isinstance(saved, list) else 1
+            if n_saved != yt.shape[0]:
+                raise ValueError(
+                    f"warm start carries {n_saved} saved output state(s) but y "
+                    f"has {yt.shape[0]} outputs (from_file needs one checkpoint "
+                    "path per output)"
+                )
         self.state_ = equation_search(
             X.T,
             yt,

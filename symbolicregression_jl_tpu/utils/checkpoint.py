@@ -19,9 +19,28 @@ grammar does not cover fall back to the sympy bridge.
 from __future__ import annotations
 
 import csv
-import types
 
-__all__ = ["load_saved_state", "parse_equation"]
+__all__ = ["LoadedState", "load_saved_state", "parse_equation"]
+
+
+class LoadedState:
+    """Warm-startable state restored from a CSV checkpoint. Quacks like
+    SearchResult for the read paths the estimators use: ``hall_of_fame``,
+    ``populations`` (empty — schedulers refill), ``options``, ``report()``."""
+
+    def __init__(self, hall_of_fame, options, variable_names=None):
+        self.hall_of_fame = hall_of_fame
+        self.populations: list = []
+        self.options = options
+        self.variable_names = variable_names
+        self.num_evals = 0.0
+
+    def report(self):
+        return self.hall_of_fame.format(self.options, self.variable_names)
+
+    @property
+    def pareto_frontier(self):
+        return self.hall_of_fame.pareto_frontier()
 
 
 def parse_equation(s: str, opset, variable_names: list[str] | None = None):
@@ -169,7 +188,4 @@ def load_saved_state(
             m = PopMember(tree, loss, loss, complexity=comp)
             hof.update(m, options)
 
-    return types.SimpleNamespace(
-        hall_of_fame=hof,
-        populations=[],
-    )
+    return LoadedState(hof, options, variable_names)

@@ -81,3 +81,41 @@ def test_load_works_across_schedulers(tmp_path):
         X, y, options=opts2, niterations=1, verbosity=0, saved_state=state
     )
     assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+
+
+def test_regressor_from_file_round_trip(tmp_path):
+    """SRRegressor.from_file: predict works immediately on the restored
+    frontier, and a refit warm-starts from it (PySR-parity API; the
+    reference core's CSV is write-only)."""
+    from symbolicregression_jl_tpu import SRRegressor
+
+    rng = np.random.default_rng(0)
+    Xs = rng.normal(size=(100, 2)).astype(np.float32)  # sklearn layout
+    ys = (2 * np.cos(Xs[:, 1]) + Xs[:, 0] ** 2 - 2).astype(np.float32)
+    kw = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=60,
+        maxsize=14,
+        seed=0,
+        scheduler="device",
+        output_file=str(tmp_path / "hof.csv"),
+    )
+    m1 = SRRegressor(niterations=3, **kw)
+    m1.fit(Xs, ys)
+    best1 = min(r["loss"] for r in m1.equations_)
+
+    m2 = SRRegressor.from_file(
+        str(tmp_path / "hof.csv"), niterations=1, **kw
+    )
+    # predict works before any fit
+    pred = m2.predict(Xs)
+    assert pred.shape == ys.shape and np.isfinite(pred).all()
+    best2 = min(r["loss"] for r in m2.equations_)
+    assert best2 == pytest.approx(best1, rel=1e-6)
+    # refit warm-starts: no ground lost on the same data
+    m2.set_params(ncycles_per_iteration=1)
+    m2.fit(Xs, ys)
+    assert min(r["loss"] for r in m2.equations_) <= best1 + 1e-6
