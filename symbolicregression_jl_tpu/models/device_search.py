@@ -129,11 +129,19 @@ _SCORE_FN_CACHE: dict = {}
 
 def _dataset_key(X, y, weights):
     """Content key for the memoization caches (computed ONCE per search —
-    tobytes() copies the arrays, so don't rebuild it per consumer)."""
+    tobytes() copies the arrays, so don't rebuild it per consumer). Shape
+    and dtype are part of the key: byte-identical buffers with different
+    layouts (e.g. (2,50) vs (50,2)) must not share a compiled score fn."""
     return (
         hash(X.tobytes()),
+        X.shape,
+        str(X.dtype),
         hash(y.tobytes()),
-        None if weights is None else hash(weights.tobytes()),
+        y.shape,
+        str(y.dtype),
+        None
+        if weights is None
+        else (hash(weights.tobytes()), weights.shape, str(weights.dtype)),
     )
 
 

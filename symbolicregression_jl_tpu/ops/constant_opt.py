@@ -248,17 +248,26 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
     structure = _Structure(*(jnp.asarray(a) for a in structure))
     P = starts.shape[0]
     chunk = max(1, min(int(os.environ.get("SR_CONSTOPT_CHUNK", 8)), P))
-    while P % chunk:
-        chunk -= 1
-    n_chunks = P // chunk
+    # Pad the batch up to a chunk multiple (duplicating tree 0) rather than
+    # shrinking the chunk to a divisor of P: shrink-to-divisor degrades to
+    # chunk=1 (fully serialized lax.map) whenever P and chunk are coprime.
+    # The main caller buckets P to a power of two, but direct callers and
+    # SR_CONSTOPT_CHUNK overrides see arbitrary (P, chunk) pairs.
+    pad = -P % chunk
+    if pad:
+        dup = lambda a: jnp.concatenate([a, jnp.repeat(a[:1], pad, axis=0)])
+        structure = _Structure(*(dup(a) for a in structure))
+        starts, mask = dup(starts), dup(mask)
+    n_chunks = (P + pad) // chunk
     if n_chunks == 1:
-        return jax.vmap(per_tree)(structure, starts, mask)
+        vals, fs = jax.vmap(per_tree)(structure, starts, mask)
+        return vals[:P], fs[:P]
     chunked = jax.tree_util.tree_map(
         lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]),
         (structure, starts, mask),
     )
     vals, fs = lax.map(lambda args: jax.vmap(per_tree)(*args), chunked)
-    return vals.reshape((P,) + vals.shape[2:]), fs.reshape((P,))
+    return vals.reshape((P + pad,) + vals.shape[2:])[:P], fs.reshape((P + pad,))[:P]
 
 
 def _optimize_constants_custom_objective(trees, scorer, options, rng):

@@ -297,7 +297,9 @@ def _swap_operands(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     n_b = jnp.sum(is_bin)
     ranks = jnp.cumsum(is_bin.astype(jnp.int32)) - 1
     pick = jax.random.randint(k1, (), 0, jnp.maximum(n_b, 1), dtype=jnp.int32)
-    p = jnp.argmax(is_bin & (ranks == pick))  # slot of chosen binary node
+    # argmax yields int64 under jax_enable_x64; pin int32 so the pointer
+    # scatters below stay int32 (future JAX errors on int64->int32 updates)
+    p = jnp.argmax(is_bin & (ranks == pick)).astype(jnp.int32)
     # children blocks: A = left subtree, B = right subtree; B ends at p-1
     r_root = tree.rhs[p]
     l_root = tree.lhs[p]
@@ -362,7 +364,7 @@ def _add_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     n_l = jnp.sum(is_leaf)
     ranks = jnp.cumsum(is_leaf.astype(jnp.int32)) - 1
     pick = jax.random.randint(k1, (), 0, jnp.maximum(n_l, 1), dtype=jnp.int32)
-    p = jnp.argmax(is_leaf & (ranks == pick))
+    p = jnp.argmax(is_leaf & (ranks == pick)).astype(jnp.int32)
     # material: binary(leaf, leaf) or unary(leaf)
     use_bin = jax.random.uniform(k2, (), dtype=jnp.float32) < (
         cfg.n_binary / max(cfg.n_binary + cfg.n_unary, 1)
@@ -444,7 +446,7 @@ def _delete_node(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
     n_op = jnp.sum(is_op)
     ranks = jnp.cumsum(is_op.astype(jnp.int32)) - 1
     pick = jax.random.randint(k1, (), 0, jnp.maximum(n_op, 1), dtype=jnp.int32)
-    p = jnp.argmax(is_op & (ranks == pick))
+    p = jnp.argmax(is_op & (ranks == pick)).astype(jnp.int32)
     keep_right = (tree.kind[p] == KIND_BINARY) & (jax.random.uniform(k2, (), dtype=jnp.float32) < 0.5)
     child = jnp.where(keep_right, tree.rhs[p], tree.lhs[p])
     ca = child - sizes[child] + 1

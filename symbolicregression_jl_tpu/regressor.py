@@ -105,6 +105,7 @@ class SRRegressor:
         niterations: int = 10,
         verbosity: int = 0,
         selection_method: Callable | None = None,
+        n_outputs: int | None = None,
         **option_kwargs: Any,
     ):
         """Restore an estimator from hall-of-fame CSV checkpoint(s) written
@@ -116,7 +117,8 @@ class SRRegressor:
         ``predict`` / ``equations_`` / ``full_report`` work immediately on
         the restored frontier; a subsequent ``fit`` warm-starts from it
         (losses are rescored against the new data). Multitarget: pass one
-        path per output (the ``{base}.out{j}`` files)."""
+        path per output (the ``{base}.out{j}`` files) plus ``n_outputs`` so
+        a wrong path count fails here instead of on a later fit."""
         import os
 
         from .utils.checkpoint import load_saved_state
@@ -135,8 +137,18 @@ class SRRegressor:
             if isinstance(path, (str, bytes, os.PathLike))
             else list(path)
         )
+        if not cls._multitarget and n_outputs not in (None, 1):
+            raise ValueError(
+                f"SRRegressor is single-output (got n_outputs={n_outputs}); "
+                "use MultitargetSRRegressor.from_file"
+            )
         if not cls._multitarget and len(paths) != 1:
             raise ValueError("SRRegressor.from_file takes exactly one path")
+        if cls._multitarget and n_outputs is not None and len(paths) != n_outputs:
+            raise ValueError(
+                f"MultitargetSRRegressor.from_file got {len(paths)} checkpoint "
+                f"path(s) but n_outputs={n_outputs}; pass one path per output"
+            )
         states = [
             load_saved_state(p, options, variable_names) for p in paths
         ]
