@@ -9,7 +9,7 @@ from .flat import (
     pad_bucket,
     unflatten_tree,
 )
-from .interp import eval_trees, eval_trees_with_ok
+from .interp import eval_diff_trees, eval_grad_trees, eval_trees, eval_trees_with_ok
 from .operators import (
     BINARY_OPS,
     UNARY_OPS,
@@ -31,6 +31,8 @@ __all__ = [
     "unflatten_tree",
     "eval_trees",
     "eval_trees_with_ok",
+    "eval_grad_trees",
+    "eval_diff_trees",
     "BINARY_OPS",
     "UNARY_OPS",
     "Operator",

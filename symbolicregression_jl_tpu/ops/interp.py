@@ -35,7 +35,7 @@ from jax import lax
 from .flat import KIND_BINARY, KIND_CONST, KIND_UNARY, KIND_VAR, FlatTrees
 from .operators import OperatorSet
 
-__all__ = ["eval_trees", "eval_trees_with_ok"]
+__all__ = ["eval_trees", "eval_trees_with_ok", "eval_grad_trees", "eval_diff_trees"]
 
 
 class _Structure(NamedTuple):
@@ -255,3 +255,52 @@ def eval_trees_with_ok(
     preds = eval_trees(flat, X, opset)
     ok = jnp.isfinite(preds).all(axis=-1)
     return preds, ok
+
+
+def eval_grad_trees(
+    flat: FlatTrees, X: jax.Array, opset: OperatorSet, wrt: str = "constants"
+) -> jax.Array:
+    """Per-row gradients of each tree's prediction — the public counterpart
+    of the reference's ``eval_grad_tree_array``
+    (/root/reference/src/InterfaceDynamicExpressions.jl:118-124).
+
+    wrt="features": d(pred)/d(X) of shape [P, F, R]. Rows are independent,
+    so the rowwise jacobian is obtained in ONE reverse pass as the gradient
+    of the row-sum (d sum_r pred[r] / dX[f, r'] = d pred[r'] / dX[f, r']).
+
+    wrt="constants": d(pred)/d(val) of shape [P, N, R] — per-row, unlike the
+    search path's row-aggregated VJP. Non-constant slots are zero. Computed
+    by vmapping a scalar grad over the row axis.
+    """
+    flat = FlatTrees(*(jnp.asarray(a) for a in flat))
+    X = jnp.asarray(X)
+    structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
+    tree_axes = (_Structure(0, 0, 0, 0, 0, 0), 0)
+
+    if wrt == "features":
+
+        def sum_pred(structure_p, val_p, X_):
+            return _eval_one(opset, structure_p, val_p, X_).sum()
+
+        fn = jax.vmap(jax.grad(sum_pred, argnums=2), in_axes=tree_axes + (None,))
+        return fn(structure, flat.val, X)
+
+    if wrt == "constants":
+
+        def row_pred(structure_p, val_p, x_col):
+            return _eval_one(opset, structure_p, val_p, x_col[:, None])[0]
+
+        per_row = jax.vmap(jax.grad(row_pred, argnums=1), in_axes=(None, None, 1))
+        fn = jax.vmap(per_row, in_axes=tree_axes + (None,))
+        return jnp.moveaxis(fn(structure, flat.val, X), 1, 2)  # [P, N, R]
+
+    raise ValueError(f"wrt must be 'features' or 'constants', got {wrt!r}")
+
+
+def eval_diff_trees(
+    flat: FlatTrees, X: jax.Array, opset: OperatorSet, direction: int
+) -> jax.Array:
+    """Directional derivative d(pred)/d(x_direction) per row: [P, R]
+    (reference ``eval_diff_tree_array``,
+    /root/reference/src/InterfaceDynamicExpressions.jl:71-95)."""
+    return eval_grad_trees(flat, X, opset, wrt="features")[:, direction, :]

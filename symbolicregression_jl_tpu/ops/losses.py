@@ -67,6 +67,13 @@ def LogitDistLoss(pred, target):
     return -jnp.log(4.0) - d + 2.0 * jnp.log1p(jnp.exp(d))
 
 
+def LogCoshLoss(pred, target):
+    # log(cosh(d)) computed as |d| + log1p(exp(-2|d|)) - log(2): the naive
+    # form overflows cosh at |d| ~ 45 in f32
+    a = jnp.abs(pred - target)
+    return a + jnp.log1p(jnp.exp(-2.0 * a)) - jnp.log(2.0)
+
+
 def PeriodicLoss(c: float = 1.0) -> Callable:
     def loss(pred, target):
         return 2.0 * jnp.sin(jnp.pi * (pred - target) / c) ** 2
@@ -136,6 +143,7 @@ LOSSES: dict[str, Callable] = {
     "L2DistLoss": L2DistLoss,
     "L1DistLoss": L1DistLoss,
     "LogitDistLoss": LogitDistLoss,
+    "LogCoshLoss": LogCoshLoss,
     "ZeroOneLoss": ZeroOneLoss,
     "PerceptronLoss": PerceptronLoss,
     "L1HingeLoss": L1HingeLoss,
@@ -155,8 +163,16 @@ LOSSES: dict[str, Callable] = {
     "DWDMarginLoss": DWDMarginLoss(1.0),
 }
 
+# aliases the reference re-exports (LossFunctions.jl names,
+# /root/reference/src/SymbolicRegression.jl:101-127)
+LOSSES["HingeLoss"] = LOSSES["L1HingeLoss"]
+LOSSES["EpsilonInsLoss"] = LOSSES["L1EpsilonInsLoss"]
+
 _FACTORIES = {
     "LPDistLoss": LPDistLoss,
+    "EpsilonInsLoss": L1EpsilonInsLoss,
+    # NB: HingeLoss is a bare alias, not a factory — "HingeLoss(2.0)" is
+    # invalid in LossFunctions.jl too and falls through to the KeyError path
     "HuberLoss": HuberLoss,
     "L1EpsilonInsLoss": L1EpsilonInsLoss,
     "L2EpsilonInsLoss": L2EpsilonInsLoss,

@@ -176,3 +176,52 @@ def test_safe_pow_semantics(x, y):
         assert math.isnan(got)
     else:
         assert math.isclose(got, want, rel_tol=1e-5), (x, y, got, want)
+
+
+def test_eval_grad_trees_features_matches_closed_form():
+    # y = c * sin(x0) + x1^2 -> d/dx0 = c cos(x0), d/dx1 = 2 x1, per row
+    from symbolicregression_jl_tpu.ops import eval_diff_trees, eval_grad_trees
+
+    c = 1.5
+    t = binary(
+        OPS.binary_index("add"),
+        binary(OPS.binary_index("mult"), constant(c), unary(OPS.unary_index("sin"), feature(0))),
+        binary(OPS.binary_index("mult"), feature(1), feature(1)),
+    )
+    t2 = feature(2)  # second tree: d/dx2 = 1, others 0
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(3, 29)).astype(np.float32)
+    flat = flatten_trees([t, t2], max_nodes=16)
+    g = np.asarray(eval_grad_trees(flat, jnp.asarray(X), OPS, wrt="features"))
+    assert g.shape == (2, 3, 29)
+    np.testing.assert_allclose(g[0, 0], c * np.cos(X[0]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(g[0, 1], 2 * X[1], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(g[0, 2], 0.0, atol=1e-7)
+    np.testing.assert_allclose(g[1, 2], 1.0, rtol=1e-6)
+    # directional wrapper slices the same tensor
+    d = np.asarray(eval_diff_trees(flat, jnp.asarray(X), OPS, direction=1))
+    np.testing.assert_allclose(d[0], g[0, 1], rtol=1e-6)
+
+
+def test_eval_grad_trees_constants_per_row():
+    # y = c0 * x0 + c1: d/dc0 = x0 (per row), d/dc1 = 1
+    from symbolicregression_jl_tpu.ops import eval_grad_trees
+
+    t = binary(
+        OPS.binary_index("add"),
+        binary(OPS.binary_index("mult"), constant(2.0), feature(0)),
+        constant(-1.0),
+    )
+    X = np.array([[1.0, 2.0, 5.0]], dtype=np.float32)
+    flat = flatten_trees([t], max_nodes=8)
+    g = np.asarray(eval_grad_trees(flat, jnp.asarray(X), OPS, wrt="constants"))
+    assert g.shape == (1, 8, 3)
+    kinds = np.asarray(flat.kind)[0]
+    const_slots = np.where(kinds == 1)[0]  # KIND_CONST
+    vals = {float(np.asarray(flat.val)[0, s]): s for s in const_slots}
+    np.testing.assert_allclose(g[0, vals[2.0]], X[0], rtol=1e-6)
+    np.testing.assert_allclose(g[0, vals[-1.0]], 1.0, rtol=1e-6)
+    # non-constant slots carry zero gradient
+    for s in range(8):
+        if s not in const_slots:
+            np.testing.assert_allclose(g[0, s], 0.0, atol=1e-7)

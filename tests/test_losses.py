@@ -48,3 +48,27 @@ def test_weighted_mean():
     w = jnp.array([[1.0, 3.0]])
     np.testing.assert_allclose(weighted_mean_loss(elem, w), [2.5])
     np.testing.assert_allclose(weighted_mean_loss(elem), [2.0])
+
+
+def test_logcosh_and_reference_aliases():
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.ops.losses import LOSSES, resolve_loss
+
+    # LogCoshLoss: stable at large |d|, exact at small |d|
+    lc = resolve_loss("LogCoshLoss")
+    d = jnp.asarray([0.0, 0.5, -3.0, 100.0])
+    want = np.log(np.cosh(np.asarray([0.0, 0.5, -3.0], dtype=np.float64)))
+    got = np.asarray(lc(d, jnp.zeros(4)))
+    np.testing.assert_allclose(got[:3], want, rtol=1e-5, atol=1e-7)
+    assert np.isfinite(got[3]) and got[3] == pytest.approx(100.0 - np.log(2.0), rel=1e-5)
+
+    # aliases the reference re-exports (src/SymbolicRegression.jl:101-127)
+    p, t = jnp.asarray([0.4, -2.0]), jnp.asarray([1.0, -1.0])
+    np.testing.assert_allclose(
+        np.asarray(LOSSES["HingeLoss"](p, t)), np.asarray(LOSSES["L1HingeLoss"](p, t))
+    )
+    np.testing.assert_allclose(
+        np.asarray(resolve_loss("EpsilonInsLoss(0.5)")(p, t)),
+        np.asarray(resolve_loss("L1EpsilonInsLoss(0.5)")(p, t)),
+    )
