@@ -1,0 +1,121 @@
+"""Round-4 ablation study: which device-engine deviations cost search quality?
+
+Round 3 measured the device engine ~44x worse on best-loss than the
+reference-semantics lockstep engine at a matched eval budget on config 3
+(PARITY_AB_r03.json: 0.0590 vs 0.00133 at ~2.3M evals). This script ablates
+the round-4 parity fixes one at a time on exactly that leg (config 3, 4
+iterations, matched budget) so every fix's contribution is measured, not
+assumed:
+
+- copt_bs    — const-opt results merge into the best-seen frontier
+               (ops/evolve.merge_best_seen via _accept_and_scatter)
+- simplify   — iteration-boundary host simplify of the decoded frontier,
+               rescored + re-injected via the migration pool
+               (models/device_search._simplified_frontier_pool)
+- poisson    — Poisson-count migration (reference semantics) vs Bernoulli
+- subbatch=K — a cycle's events scored/committed in K sub-batches against
+               fresher snapshots (staleness ablation)
+- attempts=N — in-jit mutation retries (Options.device_mutation_attempts)
+
+Each leg toggles via the SR_ABLATE env var (read in
+models/device_search.build_evo_config at search-setup time). The lockstep
+reference number is re-used from the committed PARITY_AB artifact (same data,
+same seed, same budget). Artifact: ABLATION_r04.json.
+
+Run on an idle host: each leg compiles its own engine program (~40s) then
+runs ~2-4 min on the real chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+LOCKSTEP_R03 = {  # PARITY_AB_r03.json, config 3, seed 0, 4 iterations
+    "best_loss": 0.00132907,
+    "num_evals": 2317066.0,
+    "wall_s": 939.9,
+}
+
+LEGS = [
+    # (name, SR_ABLATE value, extra Options kwargs)
+    ("r03_engine", "no_copt_bs,no_simplify,bernoulli_migration", {}),
+    ("all_fixes", "", {}),
+    ("no_copt_bs", "no_copt_bs", {}),
+    ("no_simplify", "no_simplify", {}),
+    ("bernoulli_migration", "bernoulli_migration", {}),
+    ("all+subbatch4", "subbatch=4", {}),
+    ("all+attempts3", "", {"device_mutation_attempts": 3}),
+]
+
+
+def run_leg(name, ablate, extra_kw, X, y, kw, seed, niterations=4):
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    os.environ["SR_ABLATE"] = ablate
+    try:
+        options = Options(
+            save_to_file=False, seed=seed, scheduler="device", **kw, **extra_kw
+        )
+        t0 = time.time()
+        res = equation_search(
+            X, y, options=options, niterations=niterations, verbosity=0
+        )
+        wall = time.time() - t0
+    finally:
+        os.environ.pop("SR_ABLATE", None)
+    front = {}
+    for m in sorted(res.pareto_frontier, key=lambda m: m.get_complexity(options)):
+        front[m.get_complexity(options)] = round(float(m.loss), 8)
+    best = min(front.values())
+    return {
+        "leg": name,
+        "ablate": ablate,
+        "extra": {k: v for k, v in extra_kw.items()},
+        "seed": seed,
+        "wall_s": round(wall, 1),
+        "best_loss": best,
+        "num_evals": round(res.num_evals, 0),
+        "log10_ratio_vs_lockstep": round(
+            float(np.log10((best + 1e-12) / (LOCKSTEP_R03["best_loss"] + 1e-12))), 3
+        ),
+        "front": front,
+    }
+
+
+def main(seeds=(0,), legs=LEGS):
+    from bench_problems import config3_problem
+
+    X, y, kw = config3_problem()
+    results = []
+    for name, ablate, extra in legs:
+        for seed in seeds:
+            r = run_leg(name, ablate, extra, X, y, kw, seed)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+    summary = {
+        "metric": "device_engine_ablation",
+        "config": "3_bench_10k_100x100 (4 iterations, matched budget)",
+        "lockstep_reference": LOCKSTEP_R03,
+        "legs": {
+            name: {
+                "best_loss": [r["best_loss"] for r in results if r["leg"] == name],
+                "log10_ratio": [
+                    r["log10_ratio_vs_lockstep"] for r in results if r["leg"] == name
+                ],
+                "wall_s": [r["wall_s"] for r in results if r["leg"] == name],
+            }
+            for name, _, _ in legs
+        },
+    }
+    print(json.dumps(summary), flush=True)
+    return results, summary
+
+
+if __name__ == "__main__":
+    only = [a for a in sys.argv[1:] if not a.startswith("--")]
+    legs = [l for l in LEGS if not only or l[0] in only]
+    seeds = (0, 1) if "--two-seeds" in sys.argv else (0,)
+    main(seeds=seeds, legs=legs)

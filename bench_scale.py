@@ -1,0 +1,201 @@
+"""Scale north stars: BASELINE.md configs 4 and 5, measured (round 4).
+
+Config 4 — MultitargetSRRegressor, 5 outputs x 50k rows. The round-4
+concurrent-output scheduler (Options.parallel_outputs; search.py) runs the
+five device-engine searches on a host thread pool so their device programs
+and host decode/simplify overlap. The north-star bar (VERDICT r3 #5): the
+5-output fit's search-loop wall-clock must be < 2x a single-output search of
+the same TOTAL budget (1 output x 5x iterations).
+
+Config 5 — 1M rows. Two legs:
+  (a) scoring throughput: a 512-tree batch scored on the full 1M rows via
+      the lockstep scorer's fast path (Pallas on TPU), sync-timed chain
+      style (dispatch k, block on last) -> rows/s and tree-evals/s;
+  (b) end-to-end: a short lockstep search at 1M rows with minibatching
+      (batch_size 1024) + full-data finalize -> evals/s, best loss.
+On multi-device hosts the scorer's data_sharding="rows" path shards rows
+over the mesh with a psum loss reduction (parallel/sharding.py); on the
+single tunneled chip it runs the same code single-device (the 8-way
+correctness leg runs in tests/test_sharding.py on the virtual CPU mesh).
+
+Artifact: BENCH_SCALE_r04.json. Run on an idle host.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def config4_multitarget(niters: int = 4):
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    rng = np.random.default_rng(0)
+    n = 50_000
+    X = rng.normal(size=(5, n)).astype(np.float32)
+    ys = np.stack(
+        [
+            (2 * np.cos(X[1]) + X[0] ** 2 - 2),
+            (X[0] * X[1] + np.exp(0.3 * X[2])),
+            (np.cos(2.13 * X[0]) + 0.5 * X[1] * np.abs(X[2]) ** 0.9),
+            (X[3] - 0.7 * X[4] * X[0]),
+            (np.abs(X[2]) ** 1.5 - X[1]),
+        ]
+    ).astype(np.float32)
+    kw = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=20,
+        population_size=50,
+        ncycles_per_iteration=300,
+        maxsize=20,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+
+    # leg 1: single output, 5x the iterations = the same total budget
+    t0 = time.time()
+    res1 = equation_search(
+        X, ys[0], options=Options(**kw), niterations=5 * niters, verbosity=0
+    )
+    single_wall = time.time() - t0
+    single_loop = res1.iteration_seconds
+
+    # leg 2: 5 outputs concurrently, niters each
+    t0 = time.time()
+    res5 = equation_search(
+        X, ys, options=Options(**kw), niterations=niters, verbosity=0
+    )
+    multi_wall = time.time() - t0
+    multi_loop = max(r.iteration_seconds for r in res5)
+    return {
+        "metric": "config4_multitarget_5x50k",
+        "niterations_each": niters,
+        "single_output_wall_s": round(single_wall, 1),
+        "single_output_loop_s": round(single_loop, 1),
+        "multi_wall_s": round(multi_wall, 1),
+        "multi_loop_s": round(multi_loop, 1),
+        "loop_ratio_multi_vs_single": round(multi_loop / max(single_loop, 1e-9), 2),
+        "wall_ratio_multi_vs_single": round(multi_wall / max(single_wall, 1e-9), 2),
+        "per_output_best_loss": [
+            round(min(m.loss for m in r.pareto_frontier), 6) for r in res5
+        ],
+        "total_evals": round(sum(r.num_evals for r in res5), 0),
+        "note": (
+            "ratio < 2.0 = concurrent scheduling beats serial re-runs; "
+            "wall includes per-output engine compiles (AOT-cached within a "
+            "process), loop_s is the honest steady-state number"
+        ),
+    }
+
+
+def config5_scoring_throughput(n_rows: int = 1_000_000, n_trees: int = 512):
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.dataset import Dataset
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.models.scorer import BatchScorer
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, n_rows)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        maxsize=20,
+        save_to_file=False,
+        data_sharding="rows",  # psum path on multi-device, single-dev here
+    )
+    scorer = BatchScorer(Dataset(X, y), options)
+    trees = Population.random_trees(n_trees, options, 5, rng)
+
+    # warmup (compile) then chain-timed: dispatch k batches, block on last
+    np.asarray(scorer.loss_many(trees))
+    k = 5
+    t0 = time.time()
+    outs = [scorer.loss_many_async(trees) for _ in range(k)]
+    losses = [o() for o in outs]
+    dt = time.time() - t0
+    tree_evals = k * n_trees
+    return {
+        "metric": "config5_scoring_1M_rows",
+        "n_rows": n_rows,
+        "n_trees_per_batch": n_trees,
+        "chained_batches": k,
+        "wall_s": round(dt, 2),
+        "rows_per_s": round(tree_evals * n_rows / dt, 0),
+        "tree_evals_per_s_at_1M_rows": round(tree_evals / dt, 1),
+        "finite_fraction": round(
+            float(np.mean([np.isfinite(l).mean() for l in losses])), 3
+        ),
+        "sharded_path": scorer._sharded is not None,
+    }
+
+
+def config5_e2e_search(n_rows: int = 1_000_000, niters: int = 2):
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, n_rows)).astype(np.float32)
+    y = (
+        np.cos(2.13 * X[0])
+        + 0.5 * X[1] * np.abs(X[2]) ** 0.9
+        - 0.3 * np.abs(X[3]) ** 1.5
+    ).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=10,
+        population_size=33,
+        ncycles_per_iteration=100,
+        maxsize=20,
+        batching=True,
+        batch_size=1024,
+        data_sharding="rows",
+        save_to_file=False,
+        seed=0,
+    )
+    t0 = time.time()
+    res = equation_search(X, y, options=options, niterations=niters, verbosity=0)
+    wall = time.time() - t0
+    return {
+        "metric": "config5_e2e_1M_rows",
+        "n_rows": n_rows,
+        "niterations": niters,
+        "wall_s": round(wall, 1),
+        "loop_s": round(res.iteration_seconds, 1),
+        "num_evals": round(res.num_evals, 0),
+        "evals_per_s_loop": round(res.num_evals / max(res.iteration_seconds, 1e-9), 1),
+        "best_loss": round(min(m.loss for m in res.pareto_frontier), 6),
+        "baseline_loss": round(res.dataset.baseline_loss, 6),
+    }
+
+
+def main(which=("c5score", "c5e2e", "c4")):
+    out = []
+    if "c5score" in which:
+        r = config5_scoring_throughput()
+        print(json.dumps(r), flush=True)
+        out.append(r)
+    if "c5e2e" in which:
+        r = config5_e2e_search()
+        print(json.dumps(r), flush=True)
+        out.append(r)
+    if "c4" in which:
+        r = config4_multitarget()
+        print(json.dumps(r), flush=True)
+        out.append(r)
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+
+    which = tuple(a for a in sys.argv[1:] if not a.startswith("--")) or (
+        "c5score", "c5e2e", "c4"
+    )
+    main(which)

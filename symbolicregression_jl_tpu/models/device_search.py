@@ -18,6 +18,7 @@ readbacks are packed into a single f32 array.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 
 import numpy as np
@@ -43,13 +44,6 @@ def device_mode_supported(options: Options, dataset: Dataset | None = None) -> s
         return "custom full-objective loss_function"
     if options.complexity_mapping is not None:
         return "custom complexity mapping"
-    bin_caps, una_caps = options.op_constraints
-    if any(c != (-1, -1) for c in bin_caps) or any(c != -1 for c in una_caps):
-        return "per-operator size constraints"
-    if options.nested_constraints_resolved:
-        return "nested operator constraints"
-    if options.batching:
-        return "minibatching"
     if options.data_sharding is not None:
         return "dataset row sharding"
     if dataset is not None and dataset.has_units:
@@ -70,15 +64,33 @@ def build_evo_config(
     use_baseline: bool,
     niterations: int,
     n_islands: int | None = None,
+    n_rows: int | None = None,
 ) -> EvoConfig:
     """Translate Options into the device engine's static EvoConfig.
     ``n_islands`` overrides options.populations (per-shard configs in the
-    multi-device/multi-host paths)."""
+    multi-device/multi-host paths).
+
+    SR_ABLATE (comma list; bench_ablation.py) disables individual round-4
+    parity fixes to quantify their contribution: ``no_copt_bs``,
+    ``bernoulli_migration``, ``subbatch=K`` (score/commit a cycle's events
+    in K sub-batches against fresher snapshots), ``no_simplify`` (consumed
+    by device_search_one_output, not here)."""
+    ablate = set(os.environ.get("SR_ABLATE", "").split(",")) - {""}
     I = options.populations if n_islands is None else n_islands
     P = options.population_size
     mw = options.mutation_weights
     tn = min(options.tournament_selection_n, P)
     tw = np.asarray(options.tournament_weights)[:tn]
+    ncycles = options.ncycles_per_iteration
+    events_per_cycle = max(1, -(-P // tn))
+    subbatch = next(
+        (int(t.split("=", 1)[1]) for t in ablate if t.startswith("subbatch=")), 1
+    )
+    if subbatch > 1:
+        # same events-per-iteration budget, committed in K-fold smaller
+        # batches against K-fold fresher population snapshots
+        events_per_cycle = max(1, -(-events_per_cycle // subbatch))
+        ncycles = ncycles * subbatch
     return EvoConfig(
         n_islands=I,
         pop_size=P,
@@ -111,8 +123,8 @@ def build_evo_config(
         probability_negate_constant=options.probability_negate_constant,
         baseline_loss=baseline_loss,
         use_baseline=use_baseline,
-        ncycles=options.ncycles_per_iteration,
-        events_per_cycle=max(1, -(-P // tn)),
+        ncycles=ncycles,
+        events_per_cycle=events_per_cycle,
         fraction_replaced=options.fraction_replaced,
         fraction_replaced_hof=options.fraction_replaced_hof,
         migration=options.migration,
@@ -121,10 +133,27 @@ def build_evo_config(
         niterations=niterations,
         warmup_maxsize_by=options.warmup_maxsize_by,
         mutation_attempts=int(options.device_mutation_attempts),
+        poisson_migration="bernoulli_migration" not in ablate,
+        copt_updates_bs="no_copt_bs" not in ablate,
+        bin_caps=tuple(tuple(c) for c in options.op_constraints[0]),
+        una_caps=tuple(options.op_constraints[1]),
+        nested_constraints=tuple(
+            (od, oi, tuple(tuple(inner) for inner in inners))
+            for od, oi, inners in (options.nested_constraints_resolved or ())
+        ),
+        batching=bool(options.batching),
+        eval_fraction=(
+            min(int(options.batch_size), n_rows) / n_rows
+            if options.batching and n_rows
+            else 1.0
+        ),
     )
 
 
+import threading
+
 _SCORE_FN_CACHE: dict = {}
+_CACHE_LOCK = threading.Lock()  # concurrent per-output searches share caches
 
 
 def _dataset_key(X, y, weights):
@@ -160,37 +189,55 @@ def _make_score_fn(X, y, weights, options: Options, use_pallas: bool, ds_key=Non
         options.loss,
         options.max_nodes,
         use_pallas,
+        options.batching and options.batch_size,
     )
-    fn = _SCORE_FN_CACHE.get(key)
+    with _CACHE_LOCK:
+        fn = _SCORE_FN_CACHE.get(key)
     if fn is None:
         fn = _build_score_fn(X, y, weights, options, use_pallas)
-        if len(_SCORE_FN_CACHE) >= 8:  # bound device-array retention
-            _SCORE_FN_CACHE.pop(next(iter(_SCORE_FN_CACHE)))
-        _SCORE_FN_CACHE[key] = fn
+        with _CACHE_LOCK:
+            if len(_SCORE_FN_CACHE) >= 12:  # bound device-array retention
+                _SCORE_FN_CACHE.pop(next(iter(_SCORE_FN_CACHE)))
+            fn = _SCORE_FN_CACHE.setdefault(key, fn)
     return fn
 
 
 def _build_score_fn(X, y, weights, options: Options, use_pallas: bool):
+    """Score closure: batched Tree arrays [B, N] -> losses [B]. When
+    options.batching, the closure also accepts ``score_fn(batch, key)`` —
+    losses over a fresh with-replacement row subset of batch_size (reference:
+    batch_sample + eval_loss_batched, /root/reference/src/LossFunctions.jl:114-127);
+    the keyless form always scores full data (finalize path)."""
     import jax
     import jax.numpy as jnp
 
     opset, loss_elem = options.operators, options.loss
     N = options.max_nodes
+    n_rows = X.shape[1]
+    bs = min(int(options.batch_size), n_rows) if options.batching else None
 
     if use_pallas:
         from ..ops.interp_pallas import (
             C_TILE,
             P_TILE_LOSS,
             _loss_pallas,
+            _loss_pallas_dyn,
             _reshape_rows,
             _round_up,
             pack_batch_jnp,
         )
 
         Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+        Xd = jnp.asarray(X, jnp.float32) if bs else None
+        yd = jnp.asarray(y, jnp.float32) if bs else None
+        wd = (
+            jnp.asarray(weights, jnp.float32)
+            if bs and weights is not None
+            else None
+        )
         Lv = _round_up(N, 128)
 
-        def score_fn(batch):
+        def score_fn(batch, key=None):
             B = batch.kind.shape[0]
             B_pad = _round_up(B, P_TILE_LOSS)
             ints = pack_batch_jnp(
@@ -206,10 +253,18 @@ def _build_score_fn(X, y, weights, options: Options, use_pallas: bool):
                 vals = jnp.concatenate(
                     [vals, jnp.broadcast_to(vals[:1], (B_pad - B, Lv))], axis=0
                 )
-            out = _loss_pallas(
-                ints, vals, Xr, yr, wr, opset, loss_elem,
-                N, P_TILE_LOSS, C_TILE, C, R,
-            )
+            if key is None:
+                out = _loss_pallas(
+                    ints, vals, Xr, yr, wr, opset, loss_elem,
+                    N, P_TILE_LOSS, C_TILE, C, R,
+                )
+            else:
+                idx = jax.random.choice(key, n_rows, (bs,), replace=True)
+                out = _loss_pallas_dyn(
+                    ints, vals, Xd[:, idx], yd[idx],
+                    wd[idx] if wd is not None else jnp.zeros((), jnp.float32),
+                    opset, loss_elem, N, wd is not None, bs,
+                )
             return out[:B]
 
         return score_fn
@@ -222,14 +277,22 @@ def _build_score_fn(X, y, weights, options: Options, use_pallas: bool):
     yd = jnp.asarray(y, jnp.float32)
     wd = None if weights is None else jnp.asarray(weights, jnp.float32)
 
-    def score_fn(batch):
+    def score_fn(batch, key=None):
         flat = FlatTrees(
             batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat,
             batch.val.astype(jnp.float32), batch.length,
         )
-        preds = eval_trees(flat, Xd, opset)
-        elem = loss_elem(preds, yd[None, :])
-        losses = weighted_mean_loss(elem, None if wd is None else wd[None, :])
+        if key is None:
+            Xs, ys, ws = Xd, yd, wd
+        else:
+            import jax
+
+            idx = jax.random.choice(key, n_rows, (bs,), replace=True)
+            Xs, ys = Xd[:, idx], yd[idx]
+            ws = None if wd is None else wd[idx]
+        preds = eval_trees(flat, Xs, opset)
+        elem = loss_elem(preds, ys[None, :])
+        losses = weighted_mean_loss(elem, None if ws is None else ws[None, :])
         ok = jnp.isfinite(preds).all(axis=-1)
         return jnp.where(ok, losses, jnp.inf)
 
@@ -380,6 +443,24 @@ def _accept_and_scatter(
     new_loss = jnp.where(improved, fbest, old_loss)
     comp = state.length[ii, pp].astype(jnp.float32)
     new_score = _score_of(new_loss, comp, cfg)
+    if cfg.copt_updates_bs:
+        # Fold the tuned members into the best-seen frontier. Without this,
+        # optimized constants lived only in the population: the in-jit hof
+        # migration spread UNtuned bs trees and the per-iteration readback
+        # under-reported the front (the reference's optimize step feeds the
+        # hall of fame via finalize_scores + update_hall_of_fame!,
+        # /root/reference/src/SingleIteration.jl:107-174 + main loop :916-926).
+        from ..ops.evolve import merge_best_seen
+
+        lengths = state.length[ii, pp]
+        fields = [
+            state.kind[ii, pp], state.op[ii, pp], state.lhs[ii, pp],
+            state.rhs[ii, pp], state.feat[ii, pp], new_val,
+        ]
+        valid = jnp.isfinite(new_loss) & (lengths >= 1)
+        state = merge_best_seen(
+            state, cfg, new_loss, valid, fields, lengths, axis=axis
+        )
     return state._replace(
         val=state.val.at[ii, pp].set(new_val),
         loss=state.loss.at[ii, pp].set(new_loss),
@@ -538,9 +619,12 @@ _AOT_CACHE: dict = {}
 
 
 def _aot_cache_put(key, value):
-    if len(_AOT_CACHE) >= 16:
-        _AOT_CACHE.pop(next(iter(_AOT_CACHE)))
-    _AOT_CACHE[key] = value
+    # sized for concurrent multi-output fits: 3 programs (iter/copt/readback)
+    # x up to ~10 outputs before eviction
+    with _CACHE_LOCK:
+        if len(_AOT_CACHE) >= 32:
+            _AOT_CACHE.pop(next(iter(_AOT_CACHE)))
+        _AOT_CACHE[key] = value
 
 
 def _shard_const_opt(mesh, impl):
@@ -640,6 +724,74 @@ def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
     return members
 
 
+def _rescore_members_full(members, cfg: EvoConfig, score_jit):
+    """Replace minibatch losses with full-data losses (the decode-side leg of
+    the reference's full-data best_seen rescore under batching,
+    /root/reference/src/SymbolicRegression.jl:1120-1127). Returns eval count."""
+    import jax.numpy as jnp
+
+    if not members:
+        return 0
+    trees = [m.tree for m in members]
+    pad = batch_bucket(len(trees)) - len(trees)
+    flat = flatten_trees(trees + [trees[0]] * pad, cfg.n_slots)
+    losses = np.asarray(score_jit(Tree(*(jnp.asarray(a) for a in flat))))
+    for m, loss in zip(members, losses):
+        m.loss = float(loss)
+        m.score = float(_score_of(float(loss), float(m.complexity), cfg))
+    return len(trees)
+
+
+def _simplified_frontier_pool(members, options, cfg: EvoConfig, score_jit, hof):
+    """Iteration-boundary simplify (the reference runs simplify_tree! +
+    combine_operators on EVERY member every iteration,
+    /root/reference/src/SingleIteration.jl:107-132; the device engine has no
+    in-jit tree rewriting, so the decoded best-seen frontier is simplified
+    host-side and re-injected instead — compact building blocks flow back
+    into evolution without a full-population readback).
+
+    Returns (pool, n_scored): a fixed-shape [maxsize+1] migration pool of the
+    strictly-simplified, rescored trees for migrate_from_pool (None when
+    nothing simplified), and the eval count spent rescoring. Also folds the
+    rescored members into ``hof``."""
+    import jax.numpy as jnp
+
+    from ..complexity import compute_complexity
+    from .simplify import combine_operators, simplify_tree
+
+    cand = []
+    for m in members:
+        t = combine_operators(simplify_tree(m.tree.copy(), options), options)
+        c = compute_complexity(t, options)
+        if c < m.complexity:
+            cand.append((t, c))
+    if not cand:
+        return None, 0
+    S1 = cfg.maxsize + 1
+    trees = [t for t, _ in cand][:S1]
+    flat = flatten_trees(trees + [trees[0]] * (S1 - len(trees)), cfg.n_slots)
+    batch = Tree(*(jnp.asarray(a) for a in flat))
+    losses = np.asarray(score_jit(batch)).astype(np.float32).copy()
+    losses[len(trees):] = np.inf  # pad rows are never drawn
+    for (t, c), loss in zip(cand, losses):
+        if np.isfinite(loss):
+            hof.update(
+                PopMember(
+                    t,
+                    float(_score_of(float(loss), float(c), cfg)),
+                    float(loss),
+                    complexity=int(c),
+                ),
+                options,
+            )
+    pool = (
+        jnp.asarray(flat.kind), jnp.asarray(flat.op), jnp.asarray(flat.lhs),
+        jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
+        jnp.asarray(flat.length), jnp.asarray(losses),
+    )
+    return pool, len(trees)
+
+
 def device_search_one_output(
     dataset: Dataset,
     options: Options,
@@ -648,6 +800,7 @@ def device_search_one_output(
     saved_state=None,
     verbosity: int = 1,
     output_file: str | None = None,
+    stdin_reader=None,
 ):
     """Run one output's search on the device engine. Returns SearchResult
     (same contract as models/../search._search_one_output)."""
@@ -718,6 +871,7 @@ def device_search_one_output(
         use_baseline=use_baseline,
         niterations=niterations,
         n_islands=I,
+        n_rows=dataset.n,
     )
     if cfg.warmup_maxsize_by == 0:
         # niterations only feeds the on-device warmup-maxsize schedule; with
@@ -885,6 +1039,25 @@ def device_search_one_output(
         if readback_step is None:
             readback_step = readback_fn.lower(state).compile()
             _aot_cache_put(k_rb, readback_step)
+        if options.should_simplify:
+            # prime the two lazy programs the iteration-boundary simplify
+            # uses (fixed [maxsize+1] pool shapes): an all-invalid pool makes
+            # the migrate a no-op and the scored dummy batch is discarded, so
+            # only the jit cache is warmed
+            from ..ops.evolve import migrate_from_pool as _mfp
+
+            S1 = cfg.maxsize + 1
+            zi = jnp.zeros((S1, N), jnp.int32)
+            dummy_pool = (
+                zi.at[:, 0].set(1), zi, zi, zi, zi,
+                jnp.zeros((S1, N), jnp.float32),
+                jnp.ones((S1,), jnp.int32),
+                jnp.full((S1,), jnp.inf, jnp.float32),  # invalid -> no-op
+            )
+            _mfp(state, cfg, dummy_pool, float(options.fraction_replaced_hof))
+            score_jit(
+                Tree(*dummy_pool[:6], dummy_pool[6])
+            ).block_until_ready()
     else:
         run_step = (
             iter_fn
@@ -896,10 +1069,20 @@ def device_search_one_output(
 
     from ..utils.stdin_reader import StdinReader
 
-    stdin_reader = StdinReader()
+    # an injected reader is SHARED by concurrent per-output searches ('q'
+    # quits the whole fit — its sticky latch reaches every output) and is
+    # closed by the owner, not here
+    own_stdin = stdin_reader is None
+    if own_stdin:
+        stdin_reader = StdinReader()
     start_time = time.time()
     stop_reason = None
     num_evals = 0.0
+    host_evals = 0.0  # simplify-rescore evals (host-triggered, device-run)
+    do_simplify = (
+        options.should_simplify
+        and "no_simplify" not in os.environ.get("SR_ABLATE", "").split(",")
+    )
 
     from ..ops.evolve import extract_topn_pool, migrate_from_pool
 
@@ -924,10 +1107,18 @@ def device_search_one_output(
                 _decode_readback(np.asarray(gathered[0][pi]), cfg)
                 for pi in range(n_proc)
             ]
-            num_evals = sum(d[4] for d in decoded)
+            device_evals = sum(d[4] for d in decoded)
+            decoded_members = []
             for d in decoded:
-                for m in _bs_to_members(d[0], d[1], d[2], d[3], cfg, options):
-                    hof.update(m, options)
+                decoded_members.extend(
+                    _bs_to_members(d[0], d[1], d[2], d[3], cfg, options)
+                )
+            if options.batching:
+                host_evals += _rescore_members_full(
+                    decoded_members, cfg, score_jit
+                )
+            for m in decoded_members:
+                hof.update(m, options)
             # inject the now-global pools: all processes' topn members with
             # fraction_replaced, all processes' best-seen frontiers with
             # fraction_replaced_hof (reference migrate! semantics)
@@ -947,13 +1138,35 @@ def device_search_one_output(
                     state, cfg, hof_pool, float(options.fraction_replaced_hof)
                 )
         else:
-            bs_loss, bs_exists, bs_len, fields, num_evals = _decode_readback(
+            bs_loss, bs_exists, bs_len, fields, device_evals = _decode_readback(
                 buf, cfg
             )
-            for m in _bs_to_members(
+            decoded_members = _bs_to_members(
                 bs_loss, bs_exists, bs_len, fields, cfg, options
-            ):
+            )
+            if options.batching:
+                host_evals += _rescore_members_full(
+                    decoded_members, cfg, score_jit
+                )
+            for m in decoded_members:
                 hof.update(m, options)
+
+        if do_simplify:
+            # identical deterministic work on every process in multi-host
+            # mode (same decoded input -> same pool -> same replicated-key
+            # injection), so no extra exchange is needed
+            pool, n_scored = _simplified_frontier_pool(
+                decoded_members, options, cfg, score_jit, hof
+            )
+            host_evals += n_scored
+            if pool is not None:
+                state = migrate_from_pool(
+                    state, cfg, pool, float(options.fraction_replaced_hof)
+                )
+
+        # count AFTER the iteration's host-triggered rescore/simplify evals so
+        # the max_evals stop and the returned total see them immediately
+        num_evals = device_evals + host_evals
 
         if output_file and options.save_to_file and head:
             save_hall_of_fame(output_file, hof, options, dataset.variable_names)
@@ -1002,7 +1215,8 @@ def device_search_one_output(
             break
 
     iteration_seconds = time.time() - start_time
-    stdin_reader.close()
+    if own_stdin:
+        stdin_reader.close()
 
     # --- final population readback (host Populations for warm starts) -------
     def np_at(a):

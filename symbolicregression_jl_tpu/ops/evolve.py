@@ -22,16 +22,29 @@ Reference semantics being reproduced (with citations):
 - adaptive parsimony histogram: /root/reference/src/AdaptiveParsimony.jl:20-95
 - migration: /root/reference/src/Migration.jl:16-38
 
-Deliberate deviations (documented for the parity suite):
+Deliberate deviations (documented for the parity suite; each one measured in
+ABLATION_r04.json on the config-3 matched-budget leg):
 - one mutation attempt per event with fall-back-to-skip instead of <=10
-  retries (skip_mutation_failures semantics, /root/reference/src/Mutate.jl:247-266);
-- `simplify` and `optimize` mutations are handled at iteration boundaries
-  (constant optimization) or not at all (algebraic simplify) instead of
-  in-cycle;
-- migration replaces members by independent Bernoulli(frac) draws rather than
-  a Poisson-sampled count (same mean).
-Complexity = node count (the reference default); custom complexity mappings,
-per-operator constraints and custom objectives route to the host engine.
+  retries (skip_mutation_failures semantics, /root/reference/src/Mutate.jl:247-266).
+  In-jit retries exist (Options.device_mutation_attempts) but measured WORSE
+  search quality at 3 attempts (log10_ratio 1.79 vs 0.45) and ~2x wall — keep 1;
+- a cycle's events are scored/committed against one population snapshot
+  instead of sequentially (staleness ~events_per_cycle). Measured minor:
+  4-way sub-batching (SR_ABLATE=subbatch=4) improves log10_ratio 0.45 -> 0.38
+  at ~20% more wall;
+- `simplify`/`optimize` run at iteration boundaries, not in-cycle: constant
+  optimization as a separate device program whose improvements merge into the
+  best-seen frontier (merge_best_seen), and algebraic simplify host-side on
+  the decoded frontier, re-injected via the migration pool
+  (models/device_search._simplified_frontier_pool). The simplify pass is THE
+  round-4 quality fix: without it the engine is ~27x worse on config-3
+  best-loss at matched budget; with it ~2.8x (log10 1.43 -> 0.45).
+Migration draws a Poisson count per island like the reference (Bernoulli
+ablation: no measurable difference).
+Complexity = node count (the reference default); custom complexity mappings
+and custom objectives route to the host engine. Per-operator size caps and
+nested-operator constraints ARE enforced in-jit (_constraints_ok), and
+minibatching runs in-engine (cfg.batching + full-data finalize).
 """
 
 from __future__ import annotations
@@ -66,6 +79,7 @@ __all__ = [
     "make_sharded_iteration",
     "extract_topn_pool",
     "migrate_from_pool",
+    "merge_best_seen",
 ]
 
 
@@ -115,6 +129,27 @@ class EvoConfig:
     # compiled event program and was measured 2.2x slower end-to-end with no
     # recovery-rate gain, so retries are opt-in.
     mutation_attempts: int = 1
+    # round-4 parity fixes, individually gateable for the ablation study
+    # (bench_ablation.py / ABLATION_r04.md): const-opt results merge into the
+    # best-seen frontier, and migration draws a Poisson count per island
+    poisson_migration: bool = True
+    copt_updates_bs: bool = True
+    # per-operator argument-subtree-size caps: (((lcap, rcap), ...) for binary
+    # ops, (cap, ...) for unary ops), -1 = unconstrained — and illegal-nesting
+    # combos ((outer_deg, outer_idx, ((inner_deg, inner_idx, max), ...)), ...)
+    # (reference: /root/reference/src/CheckConstraints.jl:9-70). Checked
+    # in-jit on every candidate when non-trivial.
+    bin_caps: tuple = ()
+    una_caps: tuple = ()
+    nested_constraints: tuple = ()
+    # minibatching (reference: batching + batch_size, stochastic loss during
+    # evolution + full-data finalize, /root/reference/src/LossFunctions.jl:114-127
+    # + src/Population.jl:162-176). When on, _event draws a fresh row subset
+    # per cycle (score_fn gains a key argument), evals count fractionally via
+    # eval_fraction = batch_size/n_rows, and run_iteration rescores every
+    # member on full data at the iteration boundary.
+    batching: bool = False
+    eval_fraction: float = 1.0
 
 
 class EvoState(NamedTuple):
@@ -540,6 +575,131 @@ def _apply_mutation(
     return lax.switch(kind_idx, branches, key, tree)
 
 
+def _has_op_constraints(cfg: EvoConfig) -> bool:
+    return any(c != (-1, -1) for c in cfg.bin_caps) or any(
+        c != -1 for c in cfg.una_caps
+    )
+
+
+def _nest_depth(tree: Tree, deg: int, op_idx: int) -> jax.Array:
+    """nd[i] = max count of (deg, op_idx) nodes along any root-to-leaf path of
+    the subtree at slot i (postorder forward pass; the in-jit analogue of
+    count_max_nestedness, /root/reference/src/CheckConstraints.jl:40-52)."""
+    N = tree.n_slots
+    want_kind = KIND_UNARY if deg == 1 else KIND_BINARY
+    is_target = (tree.kind == want_kind) & (tree.op == op_idx)
+    is_un = tree.kind == KIND_UNARY
+    is_bin = tree.kind == KIND_BINARY
+
+    def body(i, nd):
+        child = jnp.maximum(
+            jnp.where(is_un[i] | is_bin[i], nd[tree.lhs[i]], 0),
+            jnp.where(is_bin[i], nd[tree.rhs[i]], 0),
+        )
+        return nd.at[i].set(child + is_target[i].astype(jnp.int32))
+
+    return lax.fori_loop(0, N, body, jnp.zeros(N, jnp.int32))
+
+
+def _constraints_ok(tree: Tree, cfg: EvoConfig) -> jax.Array:
+    """Per-operator subtree-size caps + illegal-nesting combos for ONE tree
+    (in-jit counterpart of constraints.check_constraints; reference
+    /root/reference/src/CheckConstraints.jl:9-70). Static no-op (returns
+    True) when no constraints are configured."""
+    ok = jnp.asarray(True)
+    j = lax.iota(jnp.int32, tree.n_slots)
+    live = j < tree.length
+    if _has_op_constraints(cfg):
+        sizes = subtree_sizes(tree)
+        l_size = sizes[tree.lhs]
+        r_size = sizes[tree.rhs]
+        if cfg.una_caps:
+            cap_u = jnp.asarray(cfg.una_caps, jnp.int32)
+            opc = jnp.clip(tree.op, 0, len(cfg.una_caps) - 1)
+            viol = (
+                live
+                & (tree.kind == KIND_UNARY)
+                & (cap_u[opc] >= 0)
+                & (l_size > cap_u[opc])
+            )
+            ok &= ~jnp.any(viol)
+        if cfg.bin_caps:
+            caps = np.asarray(cfg.bin_caps, np.int32)  # [n_binary, 2]
+            cap_l = jnp.asarray(caps[:, 0])
+            cap_r = jnp.asarray(caps[:, 1])
+            opc = jnp.clip(tree.op, 0, len(cfg.bin_caps) - 1)
+            is_b = live & (tree.kind == KIND_BINARY)
+            viol = is_b & (
+                ((cap_l[opc] >= 0) & (l_size > cap_l[opc]))
+                | ((cap_r[opc] >= 0) & (r_size > cap_r[opc]))
+            )
+            ok &= ~jnp.any(viol)
+    if cfg.nested_constraints:
+        nd_cache: dict = {}
+        for odeg, oidx, inners in cfg.nested_constraints:
+            o_kind = KIND_UNARY if odeg == 1 else KIND_BINARY
+            is_outer = live & (tree.kind == o_kind) & (tree.op == oidx)
+            for ideg, iidx, maxn in inners:
+                nd = nd_cache.get((ideg, iidx))
+                if nd is None:
+                    nd = _nest_depth(tree, ideg, iidx)
+                    nd_cache[(ideg, iidx)] = nd
+                child_nest = jnp.maximum(
+                    nd[tree.lhs],
+                    jnp.where(tree.kind == KIND_BINARY, nd[tree.rhs], 0),
+                )
+                ok &= ~jnp.any(is_outer & (child_nest > maxn))
+    return ok
+
+
+def merge_best_seen(
+    state: EvoState, cfg: EvoConfig, losses, valid, fields, lengths, axis=None
+) -> EvoState:
+    """Fold a batch of scored trees into the best-seen frontier (the per-size
+    mini hall of fame, /root/reference/src/SingleIteration.jl:64-100).
+
+    ``losses``/``valid``/``lengths``: [B]; ``fields``: 6-list of [B, N]
+    (kind/op/lhs/rhs/feat/val). Deterministic per-size argmin via a one-hot
+    [S+1, B] mask — duplicate-index scatter order is implementation-defined
+    in XLA, so last-write-wins tricks are unsafe.
+
+    ``axis``: shard_map island-axis mode — per-shard candidates merge to a
+    global min per size (pmin), then the lowest-indexed winning shard
+    broadcasts its tree via a masked psum, keeping bs_* replicated."""
+    S1 = cfg.maxsize + 1
+    sizes = jnp.clip(lengths, 0, cfg.maxsize)
+    size_mask = sizes[None, :] == jnp.arange(S1, dtype=sizes.dtype)[:, None]
+    cand_loss = jnp.where(size_mask & valid[None, :], losses[None, :], jnp.inf)
+    best_idx = jnp.argmin(cand_loss, axis=1)  # [S1]
+    best_loss_s = jnp.min(cand_loss, axis=1)
+    cand_fields = [field[best_idx] for field in fields]  # [S1, N]
+    cand_len = lengths[best_idx]
+    if axis is not None:
+        g_loss = lax.pmin(best_loss_s, axis)
+        idx = lax.axis_index(axis)
+        win = (best_loss_s <= g_loss) & jnp.isfinite(g_loss)
+        owner = lax.pmin(jnp.where(win, idx, jnp.iinfo(jnp.int32).max), axis)
+        mine = win & (idx == owner)
+        cand_fields = [
+            lax.psum(jnp.where(mine[:, None], f, jnp.zeros_like(f)), axis)
+            for f in cand_fields
+        ]
+        cand_len = lax.psum(jnp.where(mine, cand_len, 0), axis)
+        best_loss_s = g_loss
+    better = best_loss_s < state.bs_loss
+    bs_loss = jnp.where(better, best_loss_s, state.bs_loss)
+    bt_new = [
+        jnp.where(better[:, None], f, cur)
+        for cur, f in zip(state.bs_tree[:6], cand_fields)
+    ]
+    bs_len = jnp.where(better, cand_len, state.bs_tree[6])
+    return state._replace(
+        bs_loss=bs_loss,
+        bs_tree=(*bt_new, bs_len),
+        bs_exists=state.bs_exists | better,
+    )
+
+
 # ---------------------------------------------------------------------------
 # One evolution event for every island in parallel
 # ---------------------------------------------------------------------------
@@ -568,8 +728,8 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
     # stride-2 slot scheme cannot stay collision-free, so tiny populations run
     # mutation-only (documented deviation; the reference would error earlier)
     can_pair = 2 * E <= P
-    key, k_t1, k_t2, k_mut, k_kind, k_flip, k_xo, k_acc = jax.random.split(
-        state.key, 8
+    key, k_t1, k_t2, k_mut, k_kind, k_flip, k_xo, k_acc, k_bat = jax.random.split(
+        state.key, 9
     )
 
     score_r = jnp.repeat(state.score, E, axis=0)  # [L, P], lane l -> island l//E
@@ -623,9 +783,12 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
         # the program; opt-in via Options.device_mutation_attempts.
         def _valid(c):
             depth = jax.vmap(tree_depth)(c)
-            return (c.length <= jnp.minimum(curmaxsize, N)) & (
+            ok = (c.length <= jnp.minimum(curmaxsize, N)) & (
                 depth <= cfg.maxdepth
             )
+            if _has_op_constraints(cfg) or cfg.nested_constraints:
+                ok &= jax.vmap(lambda t: _constraints_ok(t, cfg))(c)
+            return ok
 
         mutated = parent1
         mut_ok = jnp.zeros((L,), bool)
@@ -676,6 +839,8 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
     def validate(c, parent):
         depth = jax.vmap(tree_depth)(c)
         ok = (c.length <= jnp.minimum(curmaxsize, N)) & (depth <= cfg.maxdepth)
+        if _has_op_constraints(cfg) or cfg.nested_constraints:
+            ok &= jax.vmap(lambda t: _constraints_ok(t, cfg))(c)
         out = pick(c, parent, ok)
         return out, ok
 
@@ -686,7 +851,14 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
     batch = jax.tree_util.tree_map(
         lambda a, b: jnp.concatenate([a, b], axis=0), cand1, cand2
     )
-    losses = score_fn(batch)  # [2L]
+    if cfg.batching:
+        # fresh with-replacement row subset per cycle; the parent's stored
+        # loss is its own (stale-batch or finalize) loss — the same noise
+        # the reference's accept rule sees (member.score vs a fresh
+        # score_func_batched draw, /root/reference/src/Mutate.jl:268-274)
+        losses = score_fn(batch, k_bat)  # [2L]
+    else:
+        losses = score_fn(batch)  # [2L]
     loss1, loss2 = losses[:L], losses[L:]
     score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg)
     score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg)
@@ -770,59 +942,24 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
         fd = lax.psum(fd, axis)
     freq = st.freq + fd
 
-    # --- best-seen per complexity (the per-cycle mini hall of fame,
-    # /root/reference/src/SingleIteration.jl:64-100). Deterministic per-size
-    # argmin via a one-hot [S+1, 2I] mask (duplicate-index scatter order is
-    # implementation-defined in XLA, so last-write-wins tricks are unsafe) ----
+    # --- best-seen per complexity (the per-cycle mini hall of fame) ---------
     all_loss = jnp.concatenate([loss1, loss2])
     all_valid = jnp.concatenate(
         [jnp.isfinite(loss1) & ok1, jnp.isfinite(loss2) & ok2 & do_xover]
     )
-    sizes_all = jnp.clip(batch.length, 0, cfg.maxsize)
-    S1 = cfg.maxsize + 1
-    size_mask = sizes_all[None, :] == jnp.arange(S1, dtype=sizes_all.dtype)[:, None]  # [S1, 2I]
-    cand_loss = jnp.where(size_mask & all_valid[None, :], all_loss[None, :], jnp.inf)
-    best_idx = jnp.argmin(cand_loss, axis=1)  # [S1]
-    best_loss_s = jnp.min(cand_loss, axis=1)
     tree_fields = [batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat, batch.val]
-    cand_fields = [field[best_idx] for field in tree_fields]  # [S1, N]
-    cand_len = batch.length[best_idx]
-    if axis is not None:
-        # merge per-shard candidates: global min loss per size, then the
-        # lowest-indexed winning shard broadcasts its tree via a masked psum
-        g_loss = lax.pmin(best_loss_s, axis)
-        idx = lax.axis_index(axis)
-        win = (best_loss_s <= g_loss) & jnp.isfinite(g_loss)
-        owner = lax.pmin(
-            jnp.where(win, idx, jnp.iinfo(jnp.int32).max), axis
-        )
-        mine = win & (idx == owner)
-        cand_fields = [
-            lax.psum(jnp.where(mine[:, None], f, jnp.zeros_like(f)), axis)
-            for f in cand_fields
-        ]
-        cand_len = lax.psum(jnp.where(mine, cand_len, 0), axis)
-        best_loss_s = g_loss
-    better = best_loss_s < st.bs_loss
-    bs_loss = jnp.where(better, best_loss_s, st.bs_loss)
-    bt_new = [
-        jnp.where(better[:, None], f, cur)
-        for cur, f in zip(st.bs_tree[:6], cand_fields)
-    ]
-    bs_len = jnp.where(better, cand_len, st.bs_tree[6])
-    bs_exists = st.bs_exists | better
+    st = merge_best_seen(
+        st, cfg, all_loss, all_valid, tree_fields, batch.length, axis=axis
+    )
 
-    n_scored = L + jnp.sum(do_xover)
+    n_scored = (L + jnp.sum(do_xover)).astype(jnp.float32) * cfg.eval_fraction
     if axis is not None:
         n_scored = lax.psum(n_scored, axis)
     return st._replace(
         freq=freq,
-        bs_loss=bs_loss,
-        bs_tree=(*bt_new, bs_len),
-        bs_exists=bs_exists,
         key=key,
         step=st.step + 1,
-        num_evals=st.num_evals + n_scored.astype(jnp.float32),
+        num_evals=st.num_evals + n_scored,
     )
 
 
@@ -874,6 +1011,27 @@ def _run_iteration_impl(
 
     state = lax.fori_loop(0, total, body, state)
     state = state._replace(iteration=state.iteration + 1)
+
+    if cfg.batching:
+        # full-data finalize: every member's stored loss/score becomes exact
+        # before migration and constant optimization read them (reference:
+        # finalize_scores, /root/reference/src/Population.jl:162-176)
+        I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+        all_members = Tree(
+            state.kind.reshape(I * P, N), state.op.reshape(I * P, N),
+            state.lhs.reshape(I * P, N), state.rhs.reshape(I * P, N),
+            state.feat.reshape(I * P, N), state.val.reshape(I * P, N),
+            state.length.reshape(I * P),
+        )
+        full_loss = score_fn(all_members).reshape(I, P)
+        inc = jnp.asarray(I * P, jnp.float32)
+        if axis is not None:
+            inc = lax.psum(inc, axis)  # per-shard I is local; count globally
+        state = state._replace(
+            loss=full_loss,
+            score=_score_of(full_loss, state.length.astype(jnp.float32), cfg),
+            num_evals=state.num_evals + inc,
+        )
 
     # frequency-window decay (proportional-smoothing variant of move_window!,
     # /root/reference/src/AdaptiveParsimony.jl:57-89; window 100k)
@@ -991,10 +1149,20 @@ def _inject_pool(state: EvoState, cfg: EvoConfig, pool, pool_valid, frac) -> Evo
     (pool_kind, pool_op, pool_lhs, pool_rhs, pool_feat, pool_val,
      pool_len, pool_loss) = pool
     pool_n = pool_loss.shape[0]
-    key, k_sel, k_pick = jax.random.split(state.key, 3)
+    key, k_sel, k_pick, k_cnt = jax.random.split(state.key, 4)
 
-    # Bernoulli(frac) per member (reference draws a Poisson count: same mean)
-    replace = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32) < frac
+    if cfg.poisson_migration:
+        # Poisson-sampled replacement count per island, realized as "the k
+        # lowest-ranked members by a uniform draw" (reference: poisson_sample
+        # + sample-with-replacement overwrite,
+        # /root/reference/src/Migration.jl:16-38 + src/Utils.jl:143-150).
+        # Mean frac*P like Bernoulli, count variance matches the reference.
+        n_rep = jax.random.poisson(k_cnt, frac * P, (I, 1), dtype=jnp.int32)
+        u = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32)
+        rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+        replace = rank < n_rep
+    else:
+        replace = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32) < frac
     # never replace into islands from an empty pool
     any_valid = jnp.any(pool_valid)
     replace = replace & any_valid

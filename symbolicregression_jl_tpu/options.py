@@ -192,6 +192,12 @@ class Options:
     # /root/reference/src/precompile.jl:36-93)
     jit_warmup: bool = True
     data_sharding: str | None = None  # "rows" to shard dataset rows over devices
+    # multi-output fits: run the per-output device-engine searches on a host
+    # thread pool so their device programs and host decode/simplify work
+    # overlap (the reference round-robins (output, population) work units in
+    # one scheduler, /root/reference/src/SymbolicRegression.jl:676-679).
+    # Serial fallback: non-device schedulers, multi-host runs, or False here.
+    parallel_outputs: bool = True
 
     # -- derived (filled in __post_init__) -----------------------------------
     operators: OperatorSet = dataclasses.field(init=False)

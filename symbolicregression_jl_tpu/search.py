@@ -361,8 +361,7 @@ def equation_search(
                 f"y_variable_names has {len(y_names)} entries for {nout} outputs"
             )
 
-    results = []
-    for j in range(nout):
+    def _make_dataset(j):
         dataset = Dataset(
             X,
             ys[j],
@@ -376,10 +375,57 @@ def equation_search(
             from .configure import test_dataset_configuration
 
             test_dataset_configuration(dataset, options, verbosity)
-        output_file = None
-        if options.save_to_file:
-            base = options.output_file or f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"
-            output_file = base if nout == 1 else f"{base}.out{j + 1}"
+        return dataset
+
+    def _output_file(j):
+        if not options.save_to_file:
+            return None
+        base = options.output_file or f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"
+        return base if nout == 1 else f"{base}.out{j + 1}"
+
+    # --- concurrent multi-output (device scheduler): one search per host
+    # thread; device programs + host decode/simplify of different outputs
+    # overlap. The reference interleaves (output, population) work units in
+    # one async scheduler for the same reason
+    # (/root/reference/src/SymbolicRegression.jl:676-679,871-877).
+    if nout > 1 and options.scheduler == "device" and options.parallel_outputs:
+        import jax
+
+        if jax.process_count() == 1:  # threads + multi-host collectives
+            from concurrent.futures import ThreadPoolExecutor
+
+            from .models.device_search import device_search_one_output
+            from .utils.stdin_reader import StdinReader
+
+            datasets = [_make_dataset(j) for j in range(nout)]
+            child_rngs = rng.spawn(nout)
+            reader = StdinReader()  # shared; its quit latch reaches all outputs
+
+            def _run_output(j):
+                return device_search_one_output(
+                    datasets[j],
+                    options,
+                    niterations,
+                    child_rngs[j],
+                    saved_state=saved[j] if saved is not None else None,
+                    # only output 0 narrates — interleaved progress from N
+                    # threads is unreadable
+                    verbosity=verbosity if j == 0 else 0,
+                    output_file=_output_file(j),
+                    stdin_reader=reader,
+                )
+
+            try:
+                with ThreadPoolExecutor(max_workers=min(nout, 8)) as pool:
+                    results = list(pool.map(_run_output, range(nout)))
+            finally:
+                reader.close()
+            return results
+
+    results = []
+    for j in range(nout):
+        dataset = _make_dataset(j)
+        output_file = _output_file(j)
         if options.scheduler == "async":
             from .parallel.islands import async_search_one_output
 

@@ -95,13 +95,25 @@ def test_device_search_warm_start_rescores_on_changed_dataset():
 
 
 def test_device_mode_rejects_unsupported():
+    from symbolicregression_jl_tpu.models.device_search import (
+        device_mode_supported,
+    )
+
     X, y = _problem()
-    opts = _opts(constraints={"*": (3, 3)})
-    with pytest.raises(ValueError, match="size constraints"):
+    # r4: op-size/nested constraints and minibatching run IN the engine now
+    assert device_mode_supported(_opts(constraints={"*": (3, 3)})) is None
+    assert device_mode_supported(_opts(batching=True)) is None
+    assert device_mode_supported(
+        _opts(nested_constraints={"cos": {"cos": 0}})
+    ) is None
+    # still bounced to the host engines
+    opts = _opts(use_recorder=True, crossover_probability=0.0)
+    with pytest.raises(ValueError, match="recorder"):
         equation_search(X, y, options=opts, niterations=1, verbosity=0)
-    opts = _opts(batching=True)
-    with pytest.raises(ValueError, match="minibatching"):
-        equation_search(X, y, options=opts, niterations=1, verbosity=0)
+    assert device_mode_supported(
+        _opts(loss_function=lambda tree, ds, o: 0.0)
+    ) is not None
+    assert device_mode_supported(_opts(dtype="float64")) is not None
 
 
 def test_device_search_multi_output():

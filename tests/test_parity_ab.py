@@ -22,18 +22,21 @@ def _problem(n=100, seed=0):
 
 def _run(scheduler, seed=0):
     X, y = _problem()
+    # scale matters: below ~8 islands x 33 members neither engine reliably
+    # finds x0^2 and the comparison is seed noise (measured r4: 4x16 gives
+    # device ~0.6-1.1 vs lockstep ~0.09; 8x33 gives ~0.03-0.08 vs ~0.026)
     options = Options(
         binary_operators=["+", "-", "*"],
         unary_operators=["cos"],
-        populations=4,
-        population_size=16,
-        ncycles_per_iteration=80,
+        populations=8,
+        population_size=33,
+        ncycles_per_iteration=100,
         maxsize=14,
         save_to_file=False,
         seed=seed,
         scheduler=scheduler,
     )
-    res = equation_search(X, y, options=options, niterations=6, verbosity=0)
+    res = equation_search(X, y, options=options, niterations=5, verbosity=0)
     return min(m.loss for m in res.pareto_frontier)
 
 
@@ -43,8 +46,11 @@ def test_device_front_within_bounded_factor_of_lockstep():
     # both must solve the planted problem to well under the ~4.4 baseline
     assert dev < 1.5, dev
     assert lock < 1.5, lock
-    # and the fast engine may not be catastrophically worse than the
-    # reference-semantics engine on the same budget (factor bound with an
-    # absolute floor: lockstep routinely hits exact float32 zero here, and
-    # a small nonzero device loss is excellent quality, not a regression)
-    assert dev <= max(lock * 50.0, 0.05), (dev, lock)
+    # and the fast engine may not be materially worse than the
+    # reference-semantics engine on the same budget. Round 4 measured
+    # log10_ratio 0.449 (~2.8x) on the TPU-scale config-3 leg after the
+    # parity fixes (ABLATION_r04.json) and ~3.3x worst-case at this CPU
+    # scale; 8x gives one-seed noise headroom (was 50x before the fixes).
+    # The absolute floor covers lockstep hitting exact float32 zero: a small
+    # nonzero device loss is excellent quality, not a regression.
+    assert dev <= max(lock * 8.0, 0.02), (dev, lock)
