@@ -19,20 +19,33 @@ __all__ = ["test_option_configuration", "test_dataset_configuration", "test_mini
 
 def test_option_configuration(options) -> None:
     """Operator totality: every operator must be total (finite or NaN, no
-    raise) over a grid of 99 points in [-100, 100]
-    (/root/reference/src/Configure.jl:3-44). Our safe operators return NaN
-    outside their domain, so anything else is a broken custom operator."""
+    raise) over a grid of 99 points in [-100, 100] — probed on the COMPLEX
+    plane (x + xi) for complex compute dtypes, like the reference
+    (/root/reference/src/Configure.jl:3-44 incl. :33-38). Our safe operators
+    return NaN outside their domain, so anything else is a broken custom
+    operator."""
+    is_complex = np.dtype(options.dtype).kind == "c"
     grid = np.linspace(-100.0, 100.0, 99).astype(np.float64)
+    out_dtype = np.complex128 if is_complex else np.float64
+    to_arr = np.asarray
+    if is_complex:
+        grid = (grid + 1j * grid).astype(np.complex64)
+        import jax
+
+        if jax.default_backend() != "cpu":
+            # complex ops only exist on the CPU backend (Dataset.device_arrays)
+            cpu = jax.devices("cpu")[0]
+            to_arr = lambda a: jax.device_put(np.asarray(a), cpu)  # noqa: E731
     from .ops.operators import SCALAR_IMPLS
 
     def check(op, args):
         try:
             with np.errstate(all="ignore"):
-                impl = SCALAR_IMPLS.get(op.name)
+                impl = None if is_complex else SCALAR_IMPLS.get(op.name)
                 if impl is not None:
-                    out = np.array([impl(*a) for a in zip(*args)], dtype=np.float64)
+                    out = np.array([impl(*a) for a in zip(*args)], dtype=out_dtype)
                 else:
-                    out = np.asarray(op.fn(*[np.asarray(a) for a in args]), np.float64)
+                    out = np.asarray(op.fn(*[to_arr(a) for a in args]), out_dtype)
         except Exception as e:  # noqa: BLE001
             raise ValueError(
                 f"operator {op.name!r} is not total: raised {type(e).__name__} "

@@ -53,14 +53,19 @@ class Dataset:
                 raise ValueError("weights length must match number of rows")
         if self.variable_names is None:
             self.variable_names = [f"x{i + 1}" for i in range(self.n_features)]
+        # avg_y keeps y's domain: complex datasets get a complex constant
+        # predictor (loss of it is still real, reference Dataset.jl:165)
+        _scalar = (
+            complex if self.y is not None and self.y.dtype.kind == "c" else float
+        )
         if self.y is None:
             self.avg_y = None
         elif self.weights is not None:
-            self.avg_y = float(
+            self.avg_y = _scalar(
                 np.sum(self.y * self.weights) / np.sum(self.weights)
             )
         else:
-            self.avg_y = float(np.mean(self.y))
+            self.avg_y = _scalar(np.mean(self.y))
         self._device_cache: dict = {}
         # parse units into rational-exponent SI quantities (reference:
         # /root/reference/src/InterfaceDynamicQuantities.jl:24-66)
@@ -84,12 +89,28 @@ class Dataset:
             from .utils.precision import ensure_x64_for_dtype
 
             ensure_x64_for_dtype(dtype)
-            X = jnp.asarray(self.X.astype(dtype))
-            y = None if self.y is None else jnp.asarray(self.y.astype(dtype))
+            to_dev = jnp.asarray
+            if np.dtype(dtype).kind == "c":
+                import jax
+
+                if jax.default_backend() != "cpu":
+                    # XLA:TPU implements NO complex arithmetic (every op
+                    # returns Unimplemented, probed on hardware) — commit
+                    # complex data to the host CPU backend; jit computations
+                    # follow committed operands, so the whole complex search
+                    # runs there (the reference's complex path is CPU Julia)
+                    cpu = jax.devices("cpu")[0]
+                    to_dev = lambda a: jax.device_put(a, cpu)  # noqa: E731
+            X = to_dev(self.X.astype(dtype))
+            y = None if self.y is None else to_dev(self.y.astype(dtype))
+            # weights multiply a REAL elementwise loss — keep them real even
+            # for complex compute dtypes (reference loss type promotion,
+            # /root/reference/src/Dataset.jl:165)
+            w_dtype = np.empty(0, dtype).real.dtype
             w = (
                 None
                 if self.weights is None
-                else jnp.asarray(self.weights.astype(dtype))
+                else to_dev(self.weights.astype(w_dtype))
             )
             if sharding is not None:
                 import jax

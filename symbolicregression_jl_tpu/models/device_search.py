@@ -87,10 +87,13 @@ def build_evo_config(
         (int(t.split("=", 1)[1]) for t in ablate if t.startswith("subbatch=")), 1
     )
     if subbatch > 1:
-        # same events-per-iteration budget, committed in K-fold smaller
-        # batches against K-fold fresher population snapshots
+        # same events-per-iteration budget, committed in smaller batches
+        # against fresher population snapshots. ncycles is derived from the
+        # ORIGINAL total so ceil-division of events_per_cycle cannot inflate
+        # the budget (a naive ncycles*K overcounted ~30% at E=9, K=4)
+        total_events = events_per_cycle * ncycles
         events_per_cycle = max(1, -(-events_per_cycle // subbatch))
-        ncycles = ncycles * subbatch
+        ncycles = max(1, round(total_events / events_per_cycle))
     return EvoConfig(
         n_islands=I,
         pop_size=P,
@@ -764,11 +767,15 @@ def _simplified_frontier_pool(members, options, cfg: EvoConfig, score_jit, hof):
         t = combine_operators(simplify_tree(m.tree.copy(), options), options)
         c = compute_complexity(t, options)
         if c < m.complexity:
-            cand.append((t, c))
+            cand.append((t, c, m.loss))
     if not cand:
         return None, 0
     S1 = cfg.maxsize + 1
-    trees = [t for t, _ in cand][:S1]
+    # the pool has S1 fixed rows; multi-host decodes can exceed that, so keep
+    # the best-by-stored-loss candidates rather than arrival (process) order
+    cand = sorted(cand, key=lambda tc: tc[2])[:S1]
+    cand = [(t, c) for t, c, _ in cand]
+    trees = [t for t, _ in cand]
     flat = flatten_trees(trees + [trees[0]] * (S1 - len(trees)), cfg.n_slots)
     batch = Tree(*(jnp.asarray(a) for a in flat))
     losses = np.asarray(score_jit(batch)).astype(np.float32).copy()

@@ -34,7 +34,13 @@ class HallOfFame:
 
     def update(self, member: PopMember, options) -> bool:
         """Insert if best-at-its-complexity (reference: update_hall_of_fame!,
-        /root/reference/src/SearchUtils.jl:513-529). Returns True if inserted."""
+        /root/reference/src/SearchUtils.jl:513-529). Returns True if inserted.
+
+        Non-finite losses never enter: a NaN occupant would permanently block
+        its slot (`finite < nan` is False) and inf members would pollute the
+        returned frontier and warm-start state."""
+        if not np.isfinite(member.loss):
+            return False
         size = member.get_complexity(options)
         if not (0 < size <= self.capacity):
             return False

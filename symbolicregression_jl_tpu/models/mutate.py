@@ -112,18 +112,19 @@ def _apply_mutation(
     if kind == "swap_operands":
         return mf.swap_operands(tree, rng)
     if kind == "add_node":
-        return mf.append_random_op(tree, ops, nfeatures, rng)
+        return mf.append_random_op(tree, ops, nfeatures, rng, dtype=options.dtype)
     if kind == "insert_node":
-        return mf.insert_random_op(tree, ops, nfeatures, rng)
+        return mf.insert_random_op(tree, ops, nfeatures, rng, dtype=options.dtype)
     if kind == "delete_node":
-        return mf.delete_random_op(tree, ops, nfeatures, rng)
+        return mf.delete_random_op(tree, ops, nfeatures, rng, dtype=options.dtype)
     if kind == "simplify":
         tree = simplify_tree(tree, options)
         return combine_operators(tree, options)
     if kind == "randomize":
         tree_size = max(tree.count_nodes(), 3)
         return mf.gen_random_tree_fixed_size(
-            int(rng.integers(1, tree_size + 1)), ops, nfeatures, rng
+            int(rng.integers(1, tree_size + 1)), ops, nfeatures, rng,
+            dtype=options.dtype,
         )
     if kind == "form_connection":
         return mf.form_random_connection(tree, rng)

@@ -89,7 +89,14 @@ def mutate_constant(
     if node is None:
         return tree
     max_change = options.perturbation_factor * temperature + 1.0 + 0.1
-    factor = float(max_change ** rng.random())
+    if isinstance(node.val, complex) or np.dtype(options.dtype).kind == "c":
+        # complex exponent rotates the phase as well as scaling the
+        # magnitude — the reference's `maxChange^rand(rng, T)` draws a
+        # complex uniform for complex T (MutationFunctions.jl:70), and
+        # without rotation a constant's phase could only ever be negated
+        factor = complex(max_change ** complex(rng.random(), rng.random()))
+    else:
+        factor = float(max_change ** rng.random())
     if rng.random() < 0.5:
         node.val *= factor
     else:
@@ -99,10 +106,19 @@ def mutate_constant(
     return tree
 
 
-def make_random_leaf(nfeatures: int, rng: np.random.Generator) -> Node:
+def make_random_leaf(
+    nfeatures: int, rng: np.random.Generator, dtype=None
+) -> Node:
     """50/50 constant (randn) or random feature
-    (reference: /root/reference/src/MutationFunctions.jl:167-175)."""
+    (reference: /root/reference/src/MutationFunctions.jl:167-175). For a
+    complex compute dtype the constant is drawn on the complex plane —
+    phase diversity has to enter through leaves, exactly as the reference's
+    `randn(T)` draws complex normals."""
     if rng.random() < 0.5:
+        if dtype is not None and np.dtype(dtype).kind == "c":
+            return constant(
+                complex(rng.standard_normal(), rng.standard_normal())
+            )
         return constant(float(rng.standard_normal()))
     return feature(int(rng.integers(nfeatures)))
 
@@ -113,6 +129,7 @@ def _random_new_op_node(
     rng: np.random.Generator,
     child: Node,
     make_bin: bool | None = None,
+    dtype=None,
 ) -> Node:
     if make_bin is None:
         total = opset.n_binary + opset.n_unary
@@ -122,7 +139,7 @@ def _random_new_op_node(
             2,
             op=int(rng.integers(opset.n_binary)),
             l=child,
-            r=make_random_leaf(nfeatures, rng),
+            r=make_random_leaf(nfeatures, rng, dtype),
         )
     else:
         new = Node(1, op=int(rng.integers(opset.n_unary)), l=child)
@@ -135,6 +152,7 @@ def append_random_op(
     nfeatures: int,
     rng: np.random.Generator,
     make_bin: bool | None = None,
+    dtype=None,
 ) -> Node:
     """Replace a random leaf by a random operator over fresh random leaves
     (reference: /root/reference/src/MutationFunctions.jl:92-121)."""
@@ -146,32 +164,37 @@ def append_random_op(
         new = Node(
             2,
             op=int(rng.integers(opset.n_binary)),
-            l=make_random_leaf(nfeatures, rng),
-            r=make_random_leaf(nfeatures, rng),
+            l=make_random_leaf(nfeatures, rng, dtype),
+            r=make_random_leaf(nfeatures, rng, dtype),
         )
     else:
-        new = Node(1, op=int(rng.integers(opset.n_unary)), l=make_random_leaf(nfeatures, rng))
+        new = Node(
+            1, op=int(rng.integers(opset.n_unary)),
+            l=make_random_leaf(nfeatures, rng, dtype),
+        )
     _set_node(node, new)
     return tree
 
 
 def insert_random_op(
-    tree: Node, opset: OperatorSet, nfeatures: int, rng: np.random.Generator
+    tree: Node, opset: OperatorSet, nfeatures: int, rng: np.random.Generator,
+    dtype=None,
 ) -> Node:
     """Wrap a random node in a new random operator
     (reference: /root/reference/src/MutationFunctions.jl:124-143)."""
     node = random_node(tree, rng)
-    new = _random_new_op_node(opset, nfeatures, rng, node.copy())
+    new = _random_new_op_node(opset, nfeatures, rng, node.copy(), dtype=dtype)
     _set_node(node, new)
     return tree
 
 
 def prepend_random_op(
-    tree: Node, opset: OperatorSet, nfeatures: int, rng: np.random.Generator
+    tree: Node, opset: OperatorSet, nfeatures: int, rng: np.random.Generator,
+    dtype=None,
 ) -> Node:
     """Wrap the root in a new random operator
     (reference: /root/reference/src/MutationFunctions.jl:146-165)."""
-    new = _random_new_op_node(opset, nfeatures, rng, tree.copy())
+    new = _random_new_op_node(opset, nfeatures, rng, tree.copy(), dtype=dtype)
     _set_node(tree, new)
     return tree
 
@@ -188,13 +211,14 @@ def _random_node_and_parent(tree: Node, rng: np.random.Generator):
 
 
 def delete_random_op(
-    tree: Node, opset: OperatorSet, nfeatures: int, rng: np.random.Generator
+    tree: Node, opset: OperatorSet, nfeatures: int, rng: np.random.Generator,
+    dtype=None,
 ) -> Node:
     """Splice a random node out of the tree
     (reference: /root/reference/src/MutationFunctions.jl:191-234)."""
     node, parent, side = _random_node_and_parent(tree, rng)
     if node.degree == 0:
-        _set_node(node, make_random_leaf(nfeatures, rng))
+        _set_node(node, make_random_leaf(nfeatures, rng, dtype))
         return tree
     keep = node.l if (node.degree == 1 or rng.random() < 0.5) else node.r
     if side == "n":
@@ -207,30 +231,34 @@ def delete_random_op(
 
 
 def gen_random_tree(
-    length: int, opset: OperatorSet, nfeatures: int, rng: np.random.Generator
+    length: int, opset: OperatorSet, nfeatures: int, rng: np.random.Generator,
+    dtype=None,
 ) -> Node:
     """Grow by repeatedly appending random ops — may exceed `length` nodes,
     like the reference (/root/reference/src/MutationFunctions.jl:237-248)."""
     tree = constant(1.0)
     for _ in range(length):
-        tree = append_random_op(tree, opset, nfeatures, rng)
+        tree = append_random_op(tree, opset, nfeatures, rng, dtype=dtype)
     return tree
 
 
 def gen_random_tree_fixed_size(
-    node_count: int, opset: OperatorSet, nfeatures: int, rng: np.random.Generator
+    node_count: int, opset: OperatorSet, nfeatures: int, rng: np.random.Generator,
+    dtype=None,
 ) -> Node:
     """Grow to exactly node_count nodes when possible
     (reference: /root/reference/src/MutationFunctions.jl:250-268)."""
-    tree = make_random_leaf(nfeatures, rng)
+    tree = make_random_leaf(nfeatures, rng, dtype)
     cur = tree.count_nodes()
     while cur < node_count:
         if cur == node_count - 1:  # only a unary op fits
             if opset.n_unary == 0:
                 break
-            tree = append_random_op(tree, opset, nfeatures, rng, make_bin=False)
+            tree = append_random_op(
+                tree, opset, nfeatures, rng, make_bin=False, dtype=dtype
+            )
         else:
-            tree = append_random_op(tree, opset, nfeatures, rng)
+            tree = append_random_op(tree, opset, nfeatures, rng, dtype=dtype)
         cur = tree.count_nodes()
     return tree
 

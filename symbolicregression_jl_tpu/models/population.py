@@ -37,7 +37,9 @@ class Population:
         one device batch; reference inits with nlength=3,
         /root/reference/src/Population.jl:36-62)."""
         return [
-            gen_random_tree(nlength, options.operators, nfeatures, rng)
+            gen_random_tree(
+                nlength, options.operators, nfeatures, rng, dtype=options.dtype
+            )
             for _ in range(population_size)
         ]
 

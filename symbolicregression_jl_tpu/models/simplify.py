@@ -10,21 +10,35 @@ ops; unknown operators are left untouched.
 
 from __future__ import annotations
 
+import cmath
 import math
 
-from ..ops.operators import scalar_impl
+from ..ops.operators import COMPLEX_SCALAR_IMPLS, scalar_impl
 from ..tree import Node, constant
 
 __all__ = ["simplify_tree", "combine_operators"]
 
 
-def _scalar_apply(op, *args) -> float:
+def _scalar_apply(op, *args):
     """Pure-host scalar application — never dispatches to the device (a
-    single-scalar device round trip costs more than the whole fold)."""
+    single-scalar device round trip costs more than the whole fold).
+    Complex constants fold through cmath counterparts."""
+    if any(isinstance(a, complex) for a in args):
+        fn = COMPLEX_SCALAR_IMPLS.get(op.name)
+        if fn is None:
+            return complex("nan")  # unfoldable: caller keeps the subtree
+        try:
+            return complex(fn(*[complex(a) for a in args]))
+        except (ValueError, OverflowError, ZeroDivisionError):
+            return complex("nan")
     try:
         return float(scalar_impl(op)(*[float(a) for a in args]))
     except (ValueError, OverflowError, ZeroDivisionError):
         return float("nan")
+
+
+def _finite(v) -> bool:
+    return cmath.isfinite(v) if isinstance(v, complex) else math.isfinite(v)
 
 
 def simplify_tree(tree: Node, options) -> Node:
@@ -34,7 +48,7 @@ def simplify_tree(tree: Node, options) -> Node:
     for n in tree.postorder():
         if n.degree == 1 and n.l.degree == 0 and n.l.is_const:
             v = _scalar_apply(ops.unary[n.op], n.l.val)
-            if math.isfinite(v):
+            if _finite(v):
                 _to_const(n, v)
         elif (
             n.degree == 2
@@ -44,7 +58,7 @@ def simplify_tree(tree: Node, options) -> Node:
             and n.r.is_const
         ):
             v = _scalar_apply(ops.binary[n.op], n.l.val, n.r.val)
-            if math.isfinite(v):
+            if _finite(v):
                 _to_const(n, v)
     return tree
 

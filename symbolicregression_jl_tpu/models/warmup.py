@@ -52,6 +52,14 @@ def warmup_host_programs(scorer, options) -> None:
     if options.should_optimize_constants and options.optimizer_probability > 0:
         from ..ops.constant_opt import optimize_constants_batched
 
-        optimize_constants_batched([dummy] * opt_n, scorer, options, wrng)
+        # mirror the search's actual call: under batching the optimizer runs
+        # on a batch_size row subset (single_iteration.py passes
+        # batch_indices) — warming the full-data program instead both wastes
+        # a compile AND can exhaust device memory at big n (observed: worker
+        # crash at 1M rows)
+        opt_idx = scorer.batch_indices(wrng) if options.batching else None
+        optimize_constants_batched(
+            [dummy] * opt_n, scorer, options, wrng, idx=opt_idx
+        )
     # warmup evals are not real search work: keep the throughput metric honest
     scorer.num_evals = saved_evals

@@ -187,15 +187,26 @@ def _neldermead_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: in
     return verts[best], fvals[best]
 
 
-def remat_tree_loss(opset, loss_elem, X, y, w, has_w):
+def remat_tree_loss(opset, loss_elem, X, y, w, has_w, complex_n=None):
     """Interpreter loss closure with rematerialization: recompute the forward
     sweep in the backward pass instead of saving per-branch residuals —
     trades ~2x FLOPs for ~n_ops x less live memory, which is what bounds the
     BFGS batch size. Shared by _optimize_batch and the device engine's
     non-Pallas const-opt fallback (models/device_search.py); keeps the
-    6-arg _bfgs_single signature, ignoring the already-closed-over args."""
+    6-arg _bfgs_single signature, ignoring the already-closed-over args.
+
+    ``complex_n``: optimize complex constants through a REAL 2N view
+    (v = [real; imag]) so the BFGS/Nelder-Mead inner products stay valid —
+    the reference drives Optim's BFGS for complex T the equivalent way
+    (/root/reference/src/ConstantOptimization.jl:27)."""
     raw = _tree_loss_fn(opset, loss_elem)
-    ck = jax.checkpoint(lambda v, s: raw(v, s, X, y, w, has_w))
+    if complex_n is None:
+        ck = jax.checkpoint(lambda v, s: raw(v, s, X, y, w, has_w))
+    else:
+        N = complex_n
+        ck = jax.checkpoint(
+            lambda v, s: raw(v[:N] + 1j * v[N:], s, X, y, w, has_w)
+        )
 
     def loss_fn(v, s, X_, y_, w_, hw_):
         return ck(v, s)
@@ -204,9 +215,13 @@ def remat_tree_loss(opset, loss_elem, X, y, w, has_w):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("opset", "loss_elem", "iters", "has_w", "algorithm")
+    jax.jit,
+    static_argnames=("opset", "loss_elem", "iters", "has_w", "algorithm", "complex_vals"),
 )
-def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS"):
+def _optimize_batch(
+    flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS",
+    complex_vals=False,
+):
     """starts: [P, S, N] initial constant vectors (S = 1 + nrestarts).
     Returns best (val [P,N], loss [P]) over restarts per tree.
 
@@ -222,9 +237,15 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
     engine's fallback (models/device_search.py)."""
     import os
 
-    loss_fn = remat_tree_loss(opset, loss_elem, X, y, w, has_w)
+    N_slots = flat.kind.shape[1]
+    loss_fn = remat_tree_loss(
+        opset, loss_elem, X, y, w, has_w,
+        complex_n=N_slots if complex_vals else None,
+    )
     structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
     mask = flat.kind == KIND_CONST  # [P, N]
+    if complex_vals:  # starts are the real 2N view [..., real; imag]
+        mask = jnp.concatenate([mask, mask], axis=1)
     main = _bfgs_single if algorithm == "BFGS" else _neldermead_single
 
     def per_tree(struct_p, starts_p, mask_p):
@@ -247,7 +268,16 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
 
     structure = _Structure(*(jnp.asarray(a) for a in structure))
     P = starts.shape[0]
-    chunk = max(1, min(int(os.environ.get("SR_CONSTOPT_CHUNK", 8)), P))
+    chunk = int(os.environ.get("SR_CONSTOPT_CHUNK", 8))
+    # row-aware clamp: each vmapped instance holds ~[N_slots, R] remat'd
+    # interpreter registers per restart; keep a chunk under ~2GB so big-n
+    # unbatched runs degrade to smaller chunks instead of crashing the
+    # device (observed: worker crash at n=1M with chunk=8)
+    S_r = starts.shape[1]
+    R_rows = X.shape[-1]
+    per_instance = max(1, S_r * N_slots * R_rows * 4)
+    chunk = min(chunk, max(1, int(2e9 // per_instance)))
+    chunk = max(1, min(chunk, P))
     # Pad the batch up to a chunk multiple (duplicating tree 0) rather than
     # shrinking the chunk to a divisor of P: shrink-to-divisor degrades to
     # chunk=1 (fully serialized lax.map) whenever P and chunk are coprime.
@@ -261,13 +291,17 @@ def _optimize_batch(flat, X, y, w, starts, opset, loss_elem, iters, has_w, algor
     n_chunks = (P + pad) // chunk
     if n_chunks == 1:
         vals, fs = jax.vmap(per_tree)(structure, starts, mask)
-        return vals[:P], fs[:P]
-    chunked = jax.tree_util.tree_map(
-        lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]),
-        (structure, starts, mask),
-    )
-    vals, fs = lax.map(lambda args: jax.vmap(per_tree)(*args), chunked)
-    return vals.reshape((P + pad,) + vals.shape[2:])[:P], fs.reshape((P + pad,))[:P]
+    else:
+        chunked = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]),
+            (structure, starts, mask),
+        )
+        vals, fs = lax.map(lambda args: jax.vmap(per_tree)(*args), chunked)
+        vals = vals.reshape((P + pad,) + vals.shape[2:])
+        fs = fs.reshape((P + pad,))
+    if complex_vals:  # back to complex [P, N]
+        vals = vals[:, :N_slots] + 1j * vals[:, N_slots:]
+    return vals[:P], fs[:P]
 
 
 def _optimize_constants_custom_objective(trees, scorer, options, rng):
@@ -423,24 +457,37 @@ def optimize_constants_batched(
         X, y = scorer.X[:, idx], scorer.y[idx]
         w = None if scorer.w is None else scorer.w[idx]
     has_w = w is not None
-    w_arg = w if has_w else jnp.zeros((), dtype)
 
     iters = int(options.optimizer_iterations)
     if options.optimizer_f_calls_limit:
         # ~4 objective evaluations per iteration per restart (value+grad +
         # line search); the reference passes f_calls_limit to Optim.Options
         iters = max(1, min(iters, int(options.optimizer_f_calls_limit) // (4 * S)))
+    complex_vals = np.dtype(dtype).kind == "c"
+    to_dev = jnp.asarray
+    if complex_vals:
+        # optimize through the real 2N view (see remat_tree_loss); weights
+        # stay real, the loss is real, only the constants are complex
+        base = np.concatenate([base.real, base.imag], axis=-1)
+        # colocate with the CPU-committed complex dataset (see
+        # Dataset.device_arrays: XLA:TPU has no complex arithmetic)
+        dev = next(iter(X.devices())) if hasattr(X, "devices") else None
+        if dev is not None:
+            # device_put numpy DIRECTLY: jnp.asarray would first materialize
+            # the complex array on the default (TPU) device and fail there
+            to_dev = lambda a: jax.device_put(np.asarray(a), dev)  # noqa: E731
     vals, fs = _optimize_batch(
-        FlatTrees(*(jnp.asarray(a) for a in flat)),
+        FlatTrees(*(to_dev(a) for a in flat)),
         X,
         y,
-        w_arg,
-        jnp.asarray(base),
+        w if has_w else to_dev(np.zeros((), np.empty(0, dtype).real.dtype)),
+        to_dev(base),
         scorer.opset,
         scorer.loss_elem,
         iters,
         has_w,
         algorithm=options.optimizer_algorithm,
+        complex_vals=complex_vals,
     )
     vals = np.asarray(vals)
     fs = np.asarray(fs, dtype=np.float64)

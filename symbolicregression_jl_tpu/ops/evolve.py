@@ -29,9 +29,11 @@ ABLATION_r04.json on the config-3 matched-budget leg):
   In-jit retries exist (Options.device_mutation_attempts) but measured WORSE
   search quality at 3 attempts (log10_ratio 1.79 vs 0.45) and ~2x wall — keep 1;
 - a cycle's events are scored/committed against one population snapshot
-  instead of sequentially (staleness ~events_per_cycle). Measured minor:
-  4-way sub-batching (SR_ABLATE=subbatch=4) improves log10_ratio 0.45 -> 0.38
-  at ~20% more wall;
+  instead of sequentially (staleness ~events_per_cycle). Measured NEUTRAL:
+  4-way sub-batching (SR_ABLATE=subbatch=4) at a correctly matched budget
+  shows no quality gain (seeds 0/1: 1.75/0.45 vs all-fixes 0.45/0.40) and
+  costs more dispatches — an early 0.38 reading came from a budget-inflation
+  bug since fixed in build_evo_config;
 - `simplify`/`optimize` run at iteration boundaries, not in-cycle: constant
   optimization as a separate device program whose improvements merge into the
   best-seen frontier (merge_best_seen), and algebraic simplify host-side on

@@ -67,6 +67,15 @@ def LogitDistLoss(pred, target):
     return -jnp.log(4.0) - d + 2.0 * jnp.log1p(jnp.exp(d))
 
 
+def L2ComplexDistLoss(pred, target):
+    """|pred - target|^2 with a REAL result — the default elementwise loss
+    for complex searches (the loss type is the real base type,
+    /root/reference/src/Dataset.jl:165; the reference's complex test uses
+    abs2, /root/reference/test/test_abstract_numbers.jl)."""
+    d = pred - target
+    return (d * jnp.conj(d)).real
+
+
 def LogCoshLoss(pred, target):
     # log(cosh(d)) computed as |d| + log1p(exp(-2|d|)) - log(2): the naive
     # form overflows cosh at |d| ~ 45 in f32
@@ -144,6 +153,7 @@ LOSSES: dict[str, Callable] = {
     "L1DistLoss": L1DistLoss,
     "LogitDistLoss": LogitDistLoss,
     "LogCoshLoss": LogCoshLoss,
+    "L2ComplexDistLoss": L2ComplexDistLoss,
     "ZeroOneLoss": ZeroOneLoss,
     "PerceptronLoss": PerceptronLoss,
     "L1HingeLoss": L1HingeLoss,

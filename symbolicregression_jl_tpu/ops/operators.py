@@ -29,6 +29,7 @@ __all__ = [
     "BINARY_OPS",
     "resolve_operators",
     "default_operator_set",
+    "complexify_operator_set",
 ]
 
 
@@ -596,6 +597,100 @@ def default_operator_set() -> OperatorSet:
     # Reference default: binary [+, -, /, *], no unary
     # (/root/reference/src/Options.jl defaults).
     return resolve_operators(["add", "sub", "div", "mult"], [])
+
+
+# ---------------------------------------------------------------------------
+# Complex-plane variants. The reference evaluates complex datasets with the
+# RAW functions — the real-line NaN guards are unnecessary (log/sqrt/pow are
+# total on ℂ up to poles) and their `<` comparisons are undefined for complex
+# inputs; its preflight then rejects operators that are not complex-total or
+# not type-stable (/root/reference/src/Configure.jl:10,33-44 — abs: ℂ→ℝ fails
+# type stability there and is rejected here too).
+# ---------------------------------------------------------------------------
+
+_COMPLEX_IMPLS: dict[str, Callable] = {
+    "add": lambda x, y: x + y,
+    "sub": lambda x, y: x - y,
+    "mult": lambda x, y: x * y,
+    "div": lambda x, y: x / y,
+    "pow": lambda x, y: x**y,
+    "square": lambda x: x * x,
+    "cube": lambda x: x * x * x,
+    "neg": lambda x: -x,
+    "inv": lambda x: 1.0 / x,
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "tan": jnp.tan,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log2": lambda x: jnp.log(x) / np.log(2.0),
+    "log10": lambda x: jnp.log(x) / np.log(10.0),
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "cosh": jnp.cosh,
+    "sinh": jnp.sinh,
+    "tanh": jnp.tanh,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+}
+
+
+import cmath as _cmath
+
+#: scalar (host) counterparts of _COMPLEX_IMPLS for constant folding —
+#: simplify must never pay a device dispatch for one scalar
+COMPLEX_SCALAR_IMPLS: dict[str, Callable] = {
+    "add": lambda x, y: x + y,
+    "sub": lambda x, y: x - y,
+    "mult": lambda x, y: x * y,
+    "div": lambda x, y: x / y if y != 0 else complex("nan"),
+    "pow": lambda x, y: x**y if not (x == 0 and y.real < 0) else complex("nan"),
+    "square": lambda x: x * x,
+    "cube": lambda x: x * x * x,
+    "neg": lambda x: -x,
+    "inv": lambda x: 1.0 / x if x != 0 else complex("nan"),
+    "cos": _cmath.cos,
+    "sin": _cmath.sin,
+    "tan": _cmath.tan,
+    "exp": _cmath.exp,
+    "log": _cmath.log,
+    "log2": lambda x: _cmath.log(x) / _math.log(2.0),
+    "log10": lambda x: _cmath.log(x) / _math.log(10.0),
+    "log1p": lambda x: _cmath.log(1.0 + x),
+    "sqrt": _cmath.sqrt,
+    "cosh": _cmath.cosh,
+    "sinh": _cmath.sinh,
+    "tanh": _cmath.tanh,
+    "asin": _cmath.asin,
+    "acos": _cmath.acos,
+    "atan": _cmath.atan,
+    "asinh": _cmath.asinh,
+    "acosh": _cmath.acosh,
+    "atanh": _cmath.atanh,
+}
+
+
+def complexify_operator_set(opset: OperatorSet) -> OperatorSet:
+    """Swap every operator for its complex-plane implementation; raises for
+    operators with no complex-total, type-stable variant (mirrors the
+    reference preflight's rejection)."""
+    def conv(op: Operator) -> Operator:
+        fn = _COMPLEX_IMPLS.get(op.name)
+        if fn is None:
+            raise ValueError(
+                f"operator {op.name!r} has no complex implementation "
+                f"(complex-capable: {sorted(_COMPLEX_IMPLS)})"
+            )
+        return Operator(name=op.name, arity=op.arity, fn=fn, display=op.display)
+
+    return OperatorSet(
+        binary=[conv(op) for op in opset.binary],
+        unary=[conv(op) for op in opset.unary],
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -102,7 +102,12 @@ def baseline_loss(dataset, opset: OperatorSet, loss_elem, dtype=np.float32):
     """Loss of the constant avg_y predictor (reference: update_baseline_loss!,
     /root/reference/src/LossFunctions.jl:201-215). Returns (baseline, use)."""
     X, y, w = dataset.device_arrays(dtype)
-    pred = jnp.full_like(y, dataset.avg_y)
+    # build the constant predictor host-side and colocate it with y —
+    # jnp.full_like would create it on the DEFAULT device, which breaks the
+    # complex path (complex data is CPU-committed; XLA:TPU has no complex)
+    pred = np.full((dataset.n,), dataset.avg_y, dtype)
+    if hasattr(y, "devices"):
+        pred = jax.device_put(pred, next(iter(y.devices())))
     elem = loss_elem(pred[None, :], y[None, :])
     val = float(weighted_mean_loss(elem, None if w is None else w[None, :])[0])
     if np.isfinite(val):

@@ -207,6 +207,18 @@ class Options:
     def __post_init__(self):
         self.operators = resolve_operators(self.binary_operators, self.unary_operators)
         self.loss = resolve_loss(self.elementwise_loss)
+        if np.dtype(self.dtype).kind == "c":
+            # complex search (reference: test_abstract_numbers.jl): operators
+            # swap to their complex-plane variants and the default loss
+            # becomes |d|^2 — the loss type is the REAL base type, like the
+            # reference's Dataset loss-type promotion
+            # (/root/reference/src/Dataset.jl:165)
+            from .ops.operators import complexify_operator_set
+            from .ops.losses import L2ComplexDistLoss
+
+            self.operators = complexify_operator_set(self.operators)
+            if self.elementwise_loss is None:
+                self.loss = L2ComplexDistLoss
         if self.maxdepth is None:
             self.maxdepth = self.maxsize
         if self.should_simplify is None:

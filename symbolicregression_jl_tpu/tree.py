@@ -144,16 +144,16 @@ class Node:
 
     def get_constants(self) -> np.ndarray:
         """Constants in postorder — the device flattening order."""
-        return np.array(
-            [n.val for n in self.postorder() if n.degree == 0 and n.is_const],
-            dtype=np.float64,
-        )
+        vals = [n.val for n in self.postorder() if n.degree == 0 and n.is_const]
+        dt = np.complex128 if any(isinstance(v, complex) for v in vals) else np.float64
+        return np.array(vals, dtype=dt)
 
     def set_constants(self, vals) -> None:
         it = iter(np.asarray(vals).tolist())
         for n in self.postorder():
             if n.degree == 0 and n.is_const:
-                n.val = float(next(it))
+                v = next(it)
+                n.val = complex(v) if isinstance(v, complex) else float(v)
 
     def has_constants(self) -> bool:
         return any(n.degree == 0 and n.is_const for n in self)
@@ -230,7 +230,12 @@ class Node:
         """Render as a human-readable equation (reference: string_tree,
         /root/reference/src/InterfaceDynamicExpressions.jl:138-241)."""
 
-        def fmt_const(v: float) -> str:
+        def fmt_const(v) -> str:
+            if isinstance(v, complex):
+                return (
+                    f"({v.real:.{precision}g}"
+                    f"{v.imag:+.{precision}g}im)"
+                )
             return f"{v:.{precision}g}"
 
         def render(n: Node) -> str:
@@ -256,8 +261,12 @@ class Node:
         return f"Node<{self.count_nodes()} nodes>"
 
 
-def constant(val: float) -> Node:
-    return Node(0, is_const=True, val=float(val))
+def constant(val) -> Node:
+    """Constant leaf; complex values are first-class (the reference searches
+    on ℂ, /root/reference/test/test_abstract_numbers.jl)."""
+    return Node(
+        0, is_const=True, val=complex(val) if isinstance(val, complex) else float(val)
+    )
 
 
 def feature(idx: int) -> Node:

@@ -23,8 +23,12 @@ __all__ = ["ensure_x64_for_dtype"]
 
 
 def ensure_x64_for_dtype(dtype) -> None:
-    """Enable jax_enable_x64 when `dtype` needs 64-bit compute."""
-    if np.dtype(dtype).itemsize < 8:
+    """Enable jax_enable_x64 when `dtype` needs 64-bit compute. Complex
+    dtypes count by their COMPONENT width: complex64 (itemsize 8) is two
+    float32s and must not flip the flag; complex128 must."""
+    dt = np.dtype(dtype)
+    component = dt.itemsize // (2 if dt.kind == "c" else 1)
+    if component < 8:
         return
     import jax
 
