@@ -30,12 +30,8 @@ def test_option_configuration(options) -> None:
     to_arr = np.asarray
     if is_complex:
         grid = (grid + 1j * grid).astype(np.complex64)
-        import jax
-
-        if jax.default_backend() != "cpu":
-            # complex ops only exist on the CPU backend (Dataset.device_arrays)
-            cpu = jax.devices("cpu")[0]
-            to_arr = lambda a: jax.device_put(np.asarray(a), cpu)  # noqa: E731
+        # complex ops only exist on the CPU backend
+        from .utils.precision import commit_complex as to_arr  # noqa: F811
     from .ops.operators import SCALAR_IMPLS
 
     def check(op, args):

@@ -89,18 +89,14 @@ class Dataset:
             from .utils.precision import ensure_x64_for_dtype
 
             ensure_x64_for_dtype(dtype)
-            to_dev = jnp.asarray
             if np.dtype(dtype).kind == "c":
-                import jax
-
-                if jax.default_backend() != "cpu":
-                    # XLA:TPU implements NO complex arithmetic (every op
-                    # returns Unimplemented, probed on hardware) — commit
-                    # complex data to the host CPU backend; jit computations
-                    # follow committed operands, so the whole complex search
-                    # runs there (the reference's complex path is CPU Julia)
-                    cpu = jax.devices("cpu")[0]
-                    to_dev = lambda a: jax.device_put(a, cpu)  # noqa: E731
+                # complex data commits to the CPU backend (single policy
+                # home: utils.precision.commit_complex) — jit computations
+                # follow committed operands, so the whole complex search
+                # runs there (the reference's complex path is CPU Julia)
+                from .utils.precision import commit_complex as to_dev
+            else:
+                to_dev = jnp.asarray
             X = to_dev(self.X.astype(dtype))
             y = None if self.y is None else to_dev(self.y.astype(dtype))
             # weights multiply a REAL elementwise loss — keep them real even

@@ -470,11 +470,11 @@ def optimize_constants_batched(
         # stay real, the loss is real, only the constants are complex
         base = np.concatenate([base.real, base.imag], axis=-1)
         # colocate with the CPU-committed complex dataset (see
-        # Dataset.device_arrays: XLA:TPU has no complex arithmetic)
+        # Dataset.device_arrays: XLA:TPU has no complex arithmetic).
+        # device_put numpy DIRECTLY: jnp.asarray would first materialize
+        # the arrays on the default (TPU) device
         dev = next(iter(X.devices())) if hasattr(X, "devices") else None
         if dev is not None:
-            # device_put numpy DIRECTLY: jnp.asarray would first materialize
-            # the complex array on the default (TPU) device and fail there
             to_dev = lambda a: jax.device_put(np.asarray(a), dev)  # noqa: E731
     vals, fs = _optimize_batch(
         FlatTrees(*(to_dev(a) for a in flat)),

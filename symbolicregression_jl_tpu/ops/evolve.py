@@ -190,9 +190,18 @@ def _member_tree(state: EvoState, i, p) -> Tree:
     )
 
 
-def _score_of(loss, complexity, cfg: EvoConfig):
-    """loss_to_score (/root/reference/src/LossFunctions.jl:138-158)."""
-    norm = cfg.baseline_loss if (cfg.use_baseline and cfg.baseline_loss >= 0.01) else 0.01
+def _score_of(loss, complexity, cfg: EvoConfig, norm=None):
+    """loss_to_score (/root/reference/src/LossFunctions.jl:138-158).
+
+    ``norm``: pass the TRACED normalization (ScoreData.norm) inside engine
+    programs so executables stay dataset-independent; host-side decode
+    callers omit it and use the cfg constants."""
+    if norm is None:
+        norm = (
+            cfg.baseline_loss
+            if (cfg.use_baseline and cfg.baseline_loss >= 0.01)
+            else 0.01
+        )
     return loss / norm + complexity * cfg.parsimony
 
 
@@ -707,7 +716,7 @@ def merge_best_seen(
 # ---------------------------------------------------------------------------
 
 
-def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, axis=None):
+def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxsize, axis=None):
     """One full evolve pass: ALL of a cycle's events for ALL islands in one
     batched step. The reference runs a pass's events sequentially
     (/root/reference/src/RegularizedEvolution.jl:31-33); batching them against
@@ -858,12 +867,12 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
         # loss is its own (stale-batch or finalize) loss — the same noise
         # the reference's accept rule sees (member.score vs a fresh
         # score_func_batched draw, /root/reference/src/Mutate.jl:268-274)
-        losses = score_fn(batch, k_bat)  # [2L]
+        losses = score_fn(batch, data, k_bat)  # [2L]
     else:
-        losses = score_fn(batch)  # [2L]
+        losses = score_fn(batch, data)  # [2L]
     loss1, loss2 = losses[:L], losses[L:]
-    score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg)
-    score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg)
+    score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg, data.norm)
+    score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg, data.norm)
 
     # --- Metropolis accept (mutation path only; crossover children are
     # accepted whenever valid+finite, /root/reference/src/Mutate.jl:361-429) --
@@ -971,7 +980,7 @@ def _event(state: EvoState, cfg: EvoConfig, score_fn, temperature, curmaxsize, a
 
 
 def _run_iteration_impl(
-    state: EvoState, cfg: EvoConfig, score_fn, axis=None
+    state: EvoState, data, cfg: EvoConfig, score_fn, axis=None
 ) -> EvoState:
     """Advance every island through one full iteration (the reference's
     _dispatch_s_r_cycle, /root/reference/src/SymbolicRegression.jl:1088-1129):
@@ -985,7 +994,12 @@ def _run_iteration_impl(
 
     ``axis``: shard_map island-axis mode (see _event). The PRNG key stays
     replicated across shards: each shard folds in its axis index for its own
-    draws, and the replicated key advances by the same fold on every shard."""
+    draws, and the replicated key advances by the same fold on every shard.
+
+    ``data``: the dataset as a TRACED pytree (device_search.ScoreData) —
+    compiled engine executables are therefore dataset-independent and shared
+    across outputs/warm starts of the same shape (one ~40s compile serves a
+    whole multi-output fit)."""
     key_in = state.key
     if axis is not None:
         state = state._replace(
@@ -1009,7 +1023,7 @@ def _run_iteration_impl(
         # (host parity: models/single_iteration.py np.linspace(1.0, 0.0, n))
         frac = cycle.astype(jnp.float32) / max(cfg.ncycles - 1, 1)
         temperature = 1.0 - frac if cfg.annealing else jnp.asarray(1.0)
-        return _event(st, cfg, score_fn, temperature, curmaxsize, axis=axis)
+        return _event(st, data, cfg, score_fn, temperature, curmaxsize, axis=axis)
 
     state = lax.fori_loop(0, total, body, state)
     state = state._replace(iteration=state.iteration + 1)
@@ -1025,13 +1039,15 @@ def _run_iteration_impl(
             state.feat.reshape(I * P, N), state.val.reshape(I * P, N),
             state.length.reshape(I * P),
         )
-        full_loss = score_fn(all_members).reshape(I, P)
+        full_loss = score_fn(all_members, data).reshape(I, P)
         inc = jnp.asarray(I * P, jnp.float32)
         if axis is not None:
             inc = lax.psum(inc, axis)  # per-shard I is local; count globally
         state = state._replace(
             loss=full_loss,
-            score=_score_of(full_loss, state.length.astype(jnp.float32), cfg),
+            score=_score_of(
+                full_loss, state.length.astype(jnp.float32), cfg, data.norm
+            ),
             num_evals=state.num_evals + inc,
         )
 
@@ -1045,9 +1061,9 @@ def _run_iteration_impl(
 
     # --- migration (reference: /root/reference/src/Migration.jl:16-38) ------
     if cfg.migration:
-        state = _migrate(state, cfg, use_hof=False)
+        state = _migrate(state, cfg, use_hof=False, norm=data.norm)
     if cfg.hof_migration:
-        state = _migrate(state, cfg, use_hof=True)
+        state = _migrate(state, cfg, use_hof=True, norm=data.norm)
     if axis is not None:
         # re-replicate the key: every shard derives the next key from the
         # same iteration-entry key (shard streams diverged via fold_in above)
@@ -1110,10 +1126,14 @@ def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn):
     in-program collectives. ``cfg_local.n_islands`` is the PER-SHARD island
     count (global islands / pop-axis size)."""
     specs = evo_state_specs()
+    from jax.sharding import PartitionSpec as _P
+
     fn = jax.shard_map(
-        lambda st: _run_iteration_impl(st, cfg_local, score_fn, axis="pop"),
+        lambda st, data: _run_iteration_impl(
+            st, data, cfg_local, score_fn, axis="pop"
+        ),
         mesh=mesh,
-        in_specs=(specs,),
+        in_specs=(specs, _P()),  # data replicated (pytree-prefix spec)
         out_specs=specs,
         # replicated outputs are replicated by construction (psum/fold_in of
         # replicated inputs); VMA inference can't see that through the scan
@@ -1143,7 +1163,9 @@ def _topn_pool(state: EvoState, cfg: EvoConfig):
     )
 
 
-def _inject_pool(state: EvoState, cfg: EvoConfig, pool, pool_valid, frac) -> EvoState:
+def _inject_pool(
+    state: EvoState, cfg: EvoConfig, pool, pool_valid, frac, norm=None
+) -> EvoState:
     """Replace Bernoulli(frac)-chosen members with uniform samples from the
     (masked) pool; the core of every migration variant. ``pool`` is the
     8-tuple layout of _topn_pool; rows where ~pool_valid are never drawn."""
@@ -1179,7 +1201,9 @@ def _inject_pool(state: EvoState, cfg: EvoConfig, pool, pool_valid, frac) -> Evo
 
     loss = jnp.where(replace, pool_loss[src], state.loss)
     comp = jnp.where(replace, pool_len[src], state.length).astype(jnp.float32)
-    score = jnp.where(replace, _score_of(pool_loss[src], comp, cfg), state.score)
+    score = jnp.where(
+        replace, _score_of(pool_loss[src], comp, cfg, norm), state.score
+    )
     return state._replace(
         kind=mix(state.kind, pool_kind),
         op=mix(state.op, pool_op),
@@ -1195,7 +1219,7 @@ def _inject_pool(state: EvoState, cfg: EvoConfig, pool, pool_valid, frac) -> Evo
     )
 
 
-def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
+def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool, norm=None) -> EvoState:
     """Replace random members with samples from the migration pool: topn per
     island (best_sub_pop) or the best-seen frontier (hof)."""
     if use_hof:
@@ -1208,7 +1232,7 @@ def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool) -> EvoState:
         pool = _topn_pool(state, cfg)
         pool_valid = jnp.isfinite(pool[7])
         frac = cfg.fraction_replaced
-    return _inject_pool(state, cfg, pool, pool_valid, frac)
+    return _inject_pool(state, cfg, pool, pool_valid, frac, norm)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -1222,9 +1246,13 @@ def extract_topn_pool(state: EvoState, cfg: EvoConfig):
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "frac"))
-def migrate_from_pool(state: EvoState, cfg: EvoConfig, pool, frac: float) -> EvoState:
+def migrate_from_pool(
+    state: EvoState, cfg: EvoConfig, pool, frac: float, norm=None
+) -> EvoState:
     """Jitted external-pool migration: inject an (allgathered, cross-host)
-    pool into this process's islands with Bernoulli(frac) replacement.
-    Invalid rows (non-finite loss or length < 1) are never drawn."""
+    pool into this process's islands with Poisson-count replacement.
+    Invalid rows (non-finite loss or length < 1) are never drawn. ``norm``:
+    traced score normalization (ScoreData.norm) so the program is
+    dataset-independent."""
     pool_valid = jnp.isfinite(pool[7]) & (pool[6] >= 1)
-    return _inject_pool(state, cfg, pool, pool_valid, frac)
+    return _inject_pool(state, cfg, pool, pool_valid, frac, norm)

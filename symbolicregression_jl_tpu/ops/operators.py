@@ -639,6 +639,39 @@ _COMPLEX_IMPLS: dict[str, Callable] = {
 }
 
 
+#: pure-numpy counterparts of _COMPLEX_IMPLS for host evaluation
+#: (tree.eval_np / regressor predict): the jnp table would dispatch to the
+#: default device, and XLA:TPU has no complex support at all
+NP_COMPLEX_IMPLS: dict[str, Callable] = {
+    "add": np.add,
+    "sub": np.subtract,
+    "mult": np.multiply,
+    "div": np.divide,
+    "pow": np.power,
+    "square": lambda x: x * x,
+    "cube": lambda x: x * x * x,
+    "neg": np.negative,
+    "inv": np.reciprocal,
+    "cos": np.cos,
+    "sin": np.sin,
+    "tan": np.tan,
+    "exp": np.exp,
+    "log": np.log,
+    "log2": lambda x: np.log(x) / np.log(2.0),
+    "log10": lambda x: np.log(x) / np.log(10.0),
+    "log1p": np.log1p,
+    "sqrt": np.sqrt,
+    "cosh": np.cosh,
+    "sinh": np.sinh,
+    "tanh": np.tanh,
+    "asin": np.arcsin,
+    "acos": np.arccos,
+    "atan": np.arctan,
+    "asinh": np.arcsinh,
+    "acosh": np.arccosh,
+    "atanh": np.arctanh,
+}
+
 import cmath as _cmath
 
 #: scalar (host) counterparts of _COMPLEX_IMPLS for constant folding —

@@ -248,7 +248,17 @@ class SRRegressor:
         return zeros with a warning, like the reference's fallback (:335-344)."""
         import warnings
 
-        X = np.asarray(X, dtype=np.float64)
+        X = np.asarray(X)
+        # the FIT dtype decides the evaluation domain: a complex-fit model
+        # holds complex constants, and evaluating them on a real X would
+        # silently discard the imaginary parts
+        fit_options = getattr(self, "options_", None)
+        fit_complex = (
+            fit_options is not None and np.dtype(fit_options.dtype).kind == "c"
+        )
+        X = X.astype(
+            np.complex128 if (fit_complex or X.dtype.kind == "c") else np.float64
+        )
         preds = []
         for (row, _rows), res in zip(self._selected_rows(idx), self._results()):
             tree = row["member"].tree

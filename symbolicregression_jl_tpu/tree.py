@@ -203,20 +203,37 @@ class Node:
         oracle for the XLA interpreter; not a production path."""
         post = self.postorder()
         vals: dict[int, np.ndarray] = {}
-        for n in post:
-            if n.degree == 0:
-                v = (
-                    np.full(X.shape[1], n.val, dtype=X.dtype)
-                    if n.is_const
-                    else X[n.feat].astype(X.dtype)
-                )
-            elif n.degree == 1:
-                v = np.asarray(opset.unary[n.op].fn(vals[id(n.l)])).astype(X.dtype)
-            else:
-                v = np.asarray(
-                    opset.binary[n.op].fn(vals[id(n.l)], vals[id(n.r)])
-                ).astype(X.dtype)
-            vals[id(n)] = v
+        if X.dtype.kind == "c":
+            # complex hosts evaluate through numpy directly: the jnp table
+            # would dispatch to the default device (no complex on XLA:TPU)
+            from .ops.operators import NP_COMPLEX_IMPLS
+
+            def u_fn(op):
+                return NP_COMPLEX_IMPLS[op.name]
+
+            b_fn = u_fn
+        else:
+            def u_fn(op):
+                return op.fn
+
+            b_fn = u_fn
+        with np.errstate(all="ignore"):
+            for n in post:
+                if n.degree == 0:
+                    v = (
+                        np.full(X.shape[1], n.val, dtype=X.dtype)
+                        if n.is_const
+                        else X[n.feat].astype(X.dtype)
+                    )
+                elif n.degree == 1:
+                    v = np.asarray(u_fn(opset.unary[n.op])(vals[id(n.l)])).astype(
+                        X.dtype
+                    )
+                else:
+                    v = np.asarray(
+                        b_fn(opset.binary[n.op])(vals[id(n.l)], vals[id(n.r)])
+                    ).astype(X.dtype)
+                vals[id(n)] = v
         return vals[id(post[-1])]
 
     # -- printing ------------------------------------------------------------

@@ -19,8 +19,15 @@ grammar does not cover fall back to the sympy bridge.
 from __future__ import annotations
 
 import csv
+import re
 
 __all__ = ["LoadedState", "load_saved_state", "parse_equation"]
+
+# string_tree's complex-constant rendering: "(Re±Imim)", e.g. "(2-0.5im)",
+# "(1e+03+2.5e-05im)". Unambiguous vs infix binaries, which always have
+# spaces around the operator token.
+_NUM = r"(?:\d+\.?\d*|\.\d+|inf|nan)(?:[eE][+-]?\d+)?"
+_COMPLEX_RE = re.compile(rf"\((-?{_NUM})([+-]{_NUM})im\)")
 
 
 class LoadedState:
@@ -97,6 +104,10 @@ def parse_equation(s: str, opset, variable_names: list[str] | None = None):
         nonlocal pos
         c = peek()
         if c == "(":
+            m = _COMPLEX_RE.match(s, pos)
+            if m:  # complex constant literal
+                pos = m.end()
+                return constant(complex(float(m[1]), float(m[2])))
             # infix binary: (L <display> R)
             expect("(")
             left = expr()

@@ -19,7 +19,22 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["ensure_x64_for_dtype"]
+__all__ = ["ensure_x64_for_dtype", "commit_complex"]
+
+
+def commit_complex(a):
+    """device_put a (numpy) complex array onto the host CPU backend when the
+    default backend is not CPU — XLA:TPU implements no complex arithmetic
+    (probed on hardware: every op returns Unimplemented), so complex
+    computations must be steered to CPU via committed operands. The single
+    home of that policy; returns real arrays untouched."""
+    if np.asarray(a).dtype.kind != "c":
+        return np.asarray(a)
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return np.asarray(a)
+    return jax.device_put(np.asarray(a), jax.devices("cpu")[0])
 
 
 def ensure_x64_for_dtype(dtype) -> None:

@@ -118,3 +118,54 @@ def test_complex_search_recovers_planted_equation():
         opts.operators
     )
     assert "im" in s or "x1" in s
+
+
+def test_complex_regressor_fit_predict():
+    """sklearn-style estimator round trip on ℂ (predict must not force a
+    float64 cast and eval_np must not touch the default device)."""
+    from symbolicregression_jl_tpu import SRRegressor
+
+    rng = np.random.default_rng(0)
+    Xs = (rng.normal(size=(80, 1)) + 1j * rng.normal(size=(80, 1))).astype(
+        np.complex64
+    )
+    ys = ((1 + 2j) * Xs[:, 0] + (0.5 - 1j)).astype(np.complex64)
+    m = SRRegressor(
+        niterations=6,
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        dtype=np.complex64,
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=8,
+        seed=0,
+        save_to_file=False,
+        early_stop_condition=1e-4,
+    )
+    m.fit(Xs, ys)
+    pred = m.predict(Xs)
+    assert pred.dtype.kind == "c"
+    resid = np.mean(np.abs(pred - ys) ** 2)
+    assert resid < 0.3, resid
+
+
+def test_complex_constant_parse_round_trip():
+    """string_tree's '(Re±Imim)' complex literals must parse back exactly
+    (from_file checkpoint restore depends on it)."""
+    from symbolicregression_jl_tpu.utils.checkpoint import parse_equation
+    from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"], dtype=np.complex64
+    )
+    ops = opts.operators
+    t = binary(
+        ops.binary_index("*"),
+        constant(2 - 0.5j),
+        unary(ops.unary_index("cos"),
+              binary(ops.binary_index("+"), constant(-1.5e-3 + 1j), feature(0))),
+    )
+    s = t.string_tree(ops, precision=17)
+    back = parse_equation(s, ops)
+    assert t.same_structure(back), (s, back.string_tree(ops))

@@ -319,3 +319,22 @@ def test_device_batching_parity_with_lockstep():
     # by design), the iteration finalize (64/iter) and the decode rescore
     # stay whole — total ~3.6k vs ~5.6k if nothing were fractional
     assert evals["device"] < 4200, evals
+
+
+def test_score_data_cache_keys_on_norm():
+    """Two searches on the SAME data with different losses have different
+    baselines; the cached ScoreData must not leak the first one's score
+    normalization into the second (silently wrong Metropolis accepts)."""
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.device_search import _make_score_fn
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (X[0] * 2).astype(np.float32)
+    o1 = Options(binary_operators=["+", "*"], elementwise_loss="L2DistLoss")
+    o2 = Options(binary_operators=["+", "*"], elementwise_loss="L1DistLoss")
+    _, d1 = _make_score_fn(X, y, None, o1, use_pallas=False, norm=4.0)
+    _, d2 = _make_score_fn(X, y, None, o2, use_pallas=False, norm=2.0)
+    assert float(d1.norm) == 4.0
+    assert float(d2.norm) == 2.0

@@ -62,7 +62,7 @@ def _setup(I=8, P=16, ncycles=3):
     rng = np.random.default_rng(0)
     trees = Population.random_trees(I * P, options, 2, rng)
     flat = flatten_trees(trees, options.max_nodes)
-    score_fn = _make_score_fn(X, y, None, options, use_pallas=False)
+    score_fn, score_data = _make_score_fn(X, y, None, options, use_pallas=False)
     from symbolicregression_jl_tpu.ops.treeops import Tree
 
     batch = Tree(
@@ -70,19 +70,19 @@ def _setup(I=8, P=16, ncycles=3):
         jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
         jnp.asarray(flat.length),
     )
-    init_losses = np.asarray(jax.jit(score_fn)(batch))
-    return options, X, y, cfg_g, flat, init_losses, score_fn
+    init_losses = np.asarray(score_fn.jitted(batch, score_data))
+    return options, X, y, cfg_g, flat, init_losses, score_fn, score_data
 
 
 def test_sharded_iteration_matches_unsharded_invariants():
     """Same initial state through the sharded and unsharded programs: both
     must preserve the engine's invariants (valid lengths, finite frontier,
     lockstep counters); RNG streams differ by construction."""
-    options, X, y, cfg_g, flat, init_losses, score_fn = _setup()
+    options, X, y, cfg_g, flat, init_losses, score_fn, score_data = _setup()
     I, P = cfg_g.n_islands, cfg_g.pop_size
     state = init_state(flat, init_losses, cfg_g, seed=7)
 
-    st_ref = run_iteration(state, cfg_g, score_fn)
+    st_ref = run_iteration(state, score_data, cfg_g, score_fn)
 
     n_dev = 4
     mesh = make_mesh(n_dev, 1, jax.devices()[:n_dev])
@@ -91,7 +91,7 @@ def test_sharded_iteration_matches_unsharded_invariants():
         use_baseline=True, niterations=4, n_islands=I // n_dev,
     )
     step = make_sharded_iteration(mesh, cfg_l, score_fn)
-    st_sh = step(shard_evo_state(state, mesh))
+    st_sh = step(shard_evo_state(state, mesh), score_data)
 
     for st in (st_ref, st_sh):
         length = np.asarray(st.length)
@@ -114,7 +114,7 @@ def test_sharded_frontier_trees_carry_their_losses():
     loss from one shard, tree from another — would fail here)."""
     from symbolicregression_jl_tpu.ops.flat import FlatTrees, unflatten_tree
 
-    options, X, y, cfg_g, flat, init_losses, score_fn = _setup(ncycles=6)
+    options, X, y, cfg_g, flat, init_losses, score_fn, score_data = _setup(ncycles=6)
     I, P = cfg_g.n_islands, cfg_g.pop_size
     state = init_state(flat, init_losses, cfg_g, seed=11)
     n_dev = 8
@@ -124,8 +124,8 @@ def test_sharded_frontier_trees_carry_their_losses():
         use_baseline=True, niterations=4, n_islands=I // n_dev,
     )
     step = make_sharded_iteration(mesh, cfg_l, score_fn)
-    st = step(shard_evo_state(state, mesh))
-    st = step(st)
+    st = step(shard_evo_state(state, mesh), score_data)
+    st = step(st, score_data)
 
     bs_loss = np.asarray(st.bs_loss)
     bs_exists = np.asarray(st.bs_exists)
