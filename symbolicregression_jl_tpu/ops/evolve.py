@@ -39,8 +39,10 @@ ABLATION_r04.json on the config-3 matched-budget leg):
   best-seen frontier (merge_best_seen), and algebraic simplify host-side on
   the decoded frontier, re-injected via the migration pool
   (models/device_search._simplified_frontier_pool). The simplify pass is THE
-  round-4 quality fix: without it the engine is ~27x worse on config-3
-  best-loss at matched budget; with it ~2.8x (log10 1.43 -> 0.45).
+  round-4 quality fix: the seed-paired on/off ablation moves config-3
+  matched-budget log10 ratio 1.43 -> 0.45. (Absolute config-3 outcomes are
+  widely seed-distributed — log10 0.34-1.63 over 6 seeds; see
+  ABLATION_r04.json's distribution row before quoting single-seed legs.)
 Migration draws a Poisson count per island like the reference (Bernoulli
 ablation: no measurable difference).
 Complexity = node count (the reference default); custom complexity mappings
