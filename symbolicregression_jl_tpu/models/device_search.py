@@ -811,24 +811,6 @@ def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
     return members
 
 
-def _rescore_members_full(members, cfg: EvoConfig, score_call):
-    """Replace minibatch losses with full-data losses (the decode-side leg of
-    the reference's full-data best_seen rescore under batching,
-    /root/reference/src/SymbolicRegression.jl:1120-1127). Returns eval count."""
-    import jax.numpy as jnp
-
-    if not members:
-        return 0
-    trees = [m.tree for m in members]
-    pad = batch_bucket(len(trees)) - len(trees)
-    flat = flatten_trees(trees + [trees[0]] * pad, cfg.n_slots)
-    losses = np.asarray(score_call(Tree(*(jnp.asarray(a) for a in flat))))
-    for m, loss in zip(members, losses):
-        m.loss = float(loss)
-        m.score = float(_score_of(float(loss), float(m.complexity), cfg))
-    return len(trees)
-
-
 def _simplified_frontier_pool(members, options, cfg: EvoConfig, score_call, hof):
     """Iteration-boundary simplify (the reference runs simplify_tree! +
     combine_operators on EVERY member every iteration,
@@ -1237,10 +1219,9 @@ def device_search_one_output(
                 decoded_members.extend(
                     _bs_to_members(d[0], d[1], d[2], d[3], cfg, options)
                 )
-            if options.batching:
-                host_evals += _rescore_members_full(
-                    decoded_members, cfg, score_call
-                )
+            # under batching the decoded frontier already carries exact
+            # full-data losses: the engine rescores bs in-graph at the
+            # iteration boundary (_run_iteration_impl finalize)
             for m in decoded_members:
                 hof.update(m, options)
             # inject the now-global pools: all processes' topn members with
@@ -1270,10 +1251,8 @@ def device_search_one_output(
             decoded_members = _bs_to_members(
                 bs_loss, bs_exists, bs_len, fields, cfg, options
             )
-            if options.batching:
-                host_evals += _rescore_members_full(
-                    decoded_members, cfg, score_call
-                )
+            # frontier losses are already full-data-exact under batching
+            # (in-graph finalize rescore) — no host-side re-evaluation
             for m in decoded_members:
                 hof.update(m, options)
 

@@ -448,7 +448,18 @@ def optimize_constants_batched(
     # restart jitter x(1 + sigma/2 * randn), sigma=1 like the reference's
     # perturbed re-starts (/root/reference/src/ConstantOptimization.jl:53-68)
     base = flat.val[:, None, :].repeat(S, axis=1).astype(dtype)  # [P,S,N]
-    jitter = 1.0 + 0.5 * rng.standard_normal(size=(P, S - 1, N)).astype(dtype)
+    if np.dtype(dtype).kind == "c":
+        # complex noise: restarts must perturb PHASE as well as magnitude
+        # (the reference's T-typed perturbation draws complex noise — a
+        # real-only jitter can never escape a wrong-phase basin, defeating
+        # the 2N-view optimizer's restarts)
+        noise = (
+            rng.standard_normal(size=(P, S - 1, N))
+            + 1j * rng.standard_normal(size=(P, S - 1, N))
+        ) / np.sqrt(2.0)
+        jitter = 1.0 + 0.5 * noise.astype(dtype)
+    else:
+        jitter = 1.0 + 0.5 * rng.standard_normal(size=(P, S - 1, N)).astype(dtype)
     base[:, 1:, :] *= jitter
 
     if idx is None:

@@ -1052,6 +1052,33 @@ def _run_iteration_impl(
             ),
             num_evals=state.num_evals + inc,
         )
+        # full-data-honest frontier: the in-cycle merges above saw minibatch
+        # losses, so a lucky-batch draw could permanently occupy a size slot
+        # and block genuinely better candidates (the reference picks
+        # best_seen only after finalize_scores,
+        # /root/reference/src/SingleIteration.jl:64-100 + Population.jl:162-176).
+        # Rescore the frontier trees on full data, then fold the finalized
+        # population back in so membership competes on exact losses.
+        bs_len = state.bs_tree[6]
+        bs_batch = Tree(*state.bs_tree[:6], bs_len)
+        bs_full = score_fn(bs_batch, data)
+        bs_valid = state.bs_exists & jnp.isfinite(bs_full) & (bs_len >= 1)
+        state = state._replace(
+            bs_loss=jnp.where(bs_valid, bs_full, jnp.inf),
+            bs_exists=bs_valid,
+            # bs is replicated across shards (rescore is duplicated work, not
+            # extra evals), so count its rows once, without a psum
+            num_evals=state.num_evals + jnp.asarray(bs_len.shape[0], jnp.float32),
+        )
+        state = merge_best_seen(
+            state, cfg,
+            full_loss.reshape(I * P),
+            jnp.isfinite(full_loss.reshape(I * P)) & (all_members.length >= 1),
+            [all_members.kind, all_members.op, all_members.lhs,
+             all_members.rhs, all_members.feat, all_members.val],
+            all_members.length,
+            axis=axis,
+        )
 
     # frequency-window decay (proportional-smoothing variant of move_window!,
     # /root/reference/src/AdaptiveParsimony.jl:57-89; window 100k)
@@ -1177,6 +1204,13 @@ def _inject_pool(
     pool_n = pool_loss.shape[0]
     key, k_sel, k_pick, k_cnt = jax.random.split(state.key, 4)
 
+    # both count-draw variants clamp at the number of distinct migrants
+    # available, matching the reference's min(num_replace,
+    # length(migrant_candidates)) — a near-empty pool (1-2 finite rows) must
+    # not overwrite ~frac*P members with copies of the same tree
+    n_valid = jnp.sum(pool_valid.astype(jnp.int32))
+    u = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32)
+    rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
     if cfg.poisson_migration:
         # Poisson-sampled replacement count per island, realized as "the k
         # lowest-ranked members by a uniform draw" (reference: poisson_sample
@@ -1184,11 +1218,11 @@ def _inject_pool(
         # /root/reference/src/Migration.jl:16-38 + src/Utils.jl:143-150).
         # Mean frac*P like Bernoulli, count variance matches the reference.
         n_rep = jax.random.poisson(k_cnt, frac * P, (I, 1), dtype=jnp.int32)
-        u = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32)
-        rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
-        replace = rank < n_rep
+        replace = rank < jnp.minimum(n_rep, n_valid)
     else:
-        replace = jax.random.uniform(k_sel, (I, P), dtype=jnp.float32) < frac
+        # Bernoulli marks (u < frac); keeping only the n_valid lowest-u marks
+        # applies the same clamp (marked members are exactly ranks < count)
+        replace = (u < frac) & (rank < n_valid)
     # never replace into islands from an empty pool
     any_valid = jnp.any(pool_valid)
     replace = replace & any_valid

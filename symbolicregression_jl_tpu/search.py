@@ -377,10 +377,16 @@ def equation_search(
             test_dataset_configuration(dataset, options, verbosity)
         return dataset
 
+    # the timestamped default base is computed ONCE per search: per-output
+    # (and, under parallel_outputs, per-thread) regeneration could scatter a
+    # multi-output fit's .out{j} files across different base names when the
+    # wall clock ticks across a second boundary between calls
+    _default_base = f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"
+
     def _output_file(j):
         if not options.save_to_file:
             return None
-        base = options.output_file or f"hall_of_fame_{time.strftime('%Y-%m-%d_%H%M%S')}.csv"
+        base = options.output_file or _default_base
         return base if nout == 1 else f"{base}.out{j + 1}"
 
     # --- concurrent multi-output (device scheduler): one search per host

@@ -1,0 +1,256 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md round 4):
+
+1. Poisson migration clamps the replacement count to the number of valid
+   pool rows (reference: min(num_replace, length(migrant_candidates)),
+   /root/reference/src/Migration.jl:16-38).
+2. Under cfg.batching the best-seen frontier is full-data-honest at
+   iteration boundaries: frontier losses equal full-data losses and the
+   finalized population competes for membership on exact losses.
+3. predict() with complex X on a real-fit model raises a clear ValueError
+   instead of a bare KeyError from a missing complex operator impl.
+4. A multi-output fit with save_to_file and no explicit output_file writes
+   every .out{j} under ONE timestamped base (computed once per search).
+5. Complex const-opt restart jitter perturbs phase, not just magnitude.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+
+
+def _mini_cfg(**kw):
+    from symbolicregression_jl_tpu.ops.evolve import EvoConfig
+
+    base = dict(
+        n_islands=3, pop_size=8, n_slots=16, maxsize=10, maxdepth=10,
+        nfeatures=2, n_unary=1, n_binary=2, tournament_n=2,
+        tournament_weights=(0.9, 0.1), mutation_weights=(1,) * 8,
+        crossover_probability=0.0, annealing=False, alpha=0.1,
+        parsimony=0.0, use_frequency=False, use_frequency_in_tournament=False,
+        adaptive_parsimony_scaling=20.0, perturbation_factor=0.076,
+        probability_negate_constant=0.01, baseline_loss=1.0,
+        use_baseline=True, ncycles=2, events_per_cycle=4,
+        fraction_replaced=0.1, fraction_replaced_hof=0.1, migration=False,
+        hof_migration=False, topn=2, niterations=1, warmup_maxsize_by=0.0,
+    )
+    base.update(kw)
+    return EvoConfig(**base)
+
+
+def _init_engine_state(cfg, options, rng):
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops.evolve import init_state
+    from symbolicregression_jl_tpu.ops.flat import flatten_trees
+
+    trees = Population.random_trees(
+        cfg.n_islands * cfg.pop_size, options, cfg.nfeatures, rng
+    )
+    flat = flatten_trees(trees, cfg.n_slots)
+    return init_state(
+        flat, np.zeros(cfg.n_islands * cfg.pop_size), cfg,
+        int(rng.integers(0, 2**31 - 1)),
+    )
+
+
+# -- 1: Poisson migration count clamped at valid pool rows -------------------
+
+def test_poisson_migration_clamps_to_pool_size():
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.ops.evolve import migrate_from_pool
+
+    options = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        save_to_file=False,
+    )
+    cfg = _mini_cfg()
+    rng = np.random.default_rng(0)
+    state = _init_engine_state(cfg, options, rng)
+
+    # pool of 8 rows, exactly ONE valid (finite loss, length >= 1)
+    N, R = cfg.n_slots, 8
+    kind = np.zeros((R, N), np.int32)
+    kind[:, 0] = 1  # VAR leaf
+    pool_len = np.zeros((R,), np.int32)
+    pool_len[0] = 1
+    pool_loss = np.full((R,), np.inf, np.float32)
+    pool_loss[0] = 0.123
+    pool = (
+        jnp.asarray(kind), jnp.zeros((R, N), jnp.int32),
+        jnp.zeros((R, N), jnp.int32), jnp.zeros((R, N), jnp.int32),
+        jnp.zeros((R, N), jnp.int32), jnp.zeros((R, N), jnp.float32),
+        jnp.asarray(pool_len), jnp.asarray(pool_loss),
+    )
+    # frac 0.9: an unclamped draw marks ~7 replacements per island; the clamp
+    # caps at the single valid migrant — in BOTH count-draw variants
+    for poisson in (True, False):
+        cfg_v = _mini_cfg(poisson_migration=poisson)
+        out = migrate_from_pool(state, cfg_v, pool, 0.9, None)
+        loss = np.asarray(out.loss)
+        for i in range(cfg_v.n_islands):
+            n_migrated = int(np.sum(loss[i] == np.float32(0.123)))
+            assert n_migrated <= 1, (
+                f"poisson={poisson} island {i}: {n_migrated} copies of 1 migrant"
+            )
+
+
+# -- 2: batching best-seen frontier is full-data-honest ----------------------
+
+def test_batching_frontier_losses_are_full_data():
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.device_search import (
+        _make_score_fn, build_evo_config,
+    )
+    from symbolicregression_jl_tpu.ops.evolve import run_iteration
+    from symbolicregression_jl_tpu.ops.treeops import Tree
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2, 200)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2).astype(np.float32)
+    options = Options(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        populations=3, population_size=8, ncycles_per_iteration=10,
+        maxsize=10, batching=True, batch_size=16, save_to_file=False, seed=3,
+    )
+    cfg = build_evo_config(
+        options, n_features=2, baseline_loss=1.0, use_baseline=True,
+        niterations=1, n_rows=X.shape[1],
+    )
+    assert cfg.batching and cfg.eval_fraction < 1.0
+    score_fn, data = _make_score_fn(X, y, None, options, use_pallas=False)
+    state = _init_engine_state(cfg, options, rng)
+    state = run_iteration(state, data, cfg, score_fn)
+
+    exists = np.asarray(state.bs_exists)
+    assert exists.any()
+    bs_len = state.bs_tree[6]
+    full = np.asarray(
+        score_fn(Tree(*state.bs_tree[:6], bs_len), data)  # 2-arg: full data
+    )
+    bs_loss = np.asarray(state.bs_loss)
+    # a lucky minibatch draw must not survive the iteration boundary: every
+    # frontier loss equals the full-data loss of its tree
+    np.testing.assert_allclose(bs_loss[exists], full[exists], rtol=1e-5)
+    # and the population's finalized losses competed for membership: the
+    # frontier at each occupied size is at least as good as every same-size
+    # population member's full-data loss
+    lengths = np.asarray(state.length)
+    losses = np.asarray(state.loss)
+    for s in np.unique(np.clip(lengths, 0, cfg.maxsize)):
+        pop_best = np.min(losses[np.clip(lengths, 0, cfg.maxsize) == s])
+        if np.isfinite(pop_best) and exists[s]:
+            assert bs_loss[s] <= pop_best + 1e-5
+
+
+# -- 3: complex X on a real fit raises a clear error -------------------------
+
+def test_predict_complex_x_on_real_fit_raises(tmp_path):
+    from symbolicregression_jl_tpu import SRRegressor
+
+    X = np.ones((4, 1), np.float64)
+    # abs has no complex implementation and appears in the SELECTED tree:
+    # complex X must fail with the operator named, not a bare KeyError
+    p = tmp_path / "hof_abs.csv"
+    p.write_text("Complexity,Loss,Equation\n2,1.0,abs(x0)\n")
+    m = SRRegressor.from_file(
+        str(p), binary_operators=["+"], unary_operators=["abs"]
+    )
+    assert np.all(np.isfinite(m.predict(X)))
+    with pytest.raises(ValueError, match="abs"):
+        m.predict(X.astype(np.complex128))
+    # the guard inspects the SELECTED equation, not the configured set: the
+    # same operator config with an abs-free winner keeps analytic
+    # continuation working on complex X
+    p2 = tmp_path / "hof_plain.csv"
+    p2.write_text("Complexity,Loss,Equation\n1,1.0,x0\n")
+    m2 = SRRegressor.from_file(
+        str(p2), binary_operators=["+"], unary_operators=["abs"]
+    )
+    out = m2.predict((X + 0.5j).astype(np.complex128))
+    np.testing.assert_allclose(out, X[:, 0] + 0.5j)
+
+
+# -- 4: one timestamped base per multi-output fit ----------------------------
+
+def test_multioutput_default_output_file_shares_base(tmp_path, monkeypatch):
+    import symbolicregression_jl_tpu.search as search_mod
+
+    monkeypatch.chdir(tmp_path)
+    counter = {"n": 0}
+    real_strftime = search_mod.time.strftime
+
+    def ticking_strftime(fmt, *a):
+        # simulate the wall clock crossing a second boundary between calls
+        counter["n"] += 1
+        return f"tick{counter['n']}"
+
+    monkeypatch.setattr(search_mod.time, "strftime", ticking_strftime)
+    try:
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2, 32)).astype(np.float32)
+        y = np.stack([X[0] + X[1], X[0] - X[1]]).astype(np.float32)
+        options = Options(
+            populations=2, population_size=8, ncycles_per_iteration=8,
+            maxsize=5, save_to_file=True, output_file=None, seed=0,
+        )
+        equation_search(X, y, options=options, niterations=1, verbosity=0)
+    finally:
+        monkeypatch.setattr(search_mod.time, "strftime", real_strftime)
+    outs = sorted(f.name for f in tmp_path.iterdir() if ".out" in f.name)
+    bases = {name.rsplit(".out", 1)[0] for name in outs}
+    assert len(outs) >= 2
+    assert len(bases) == 1, f"scattered bases: {sorted(bases)}"
+
+
+# -- 5: complex restart jitter perturbs phase --------------------------------
+
+class _RecordingRNG:
+    """Delegates to a real Generator, recording standard_normal shapes."""
+
+    def __init__(self, seed=0):
+        self.inner = np.random.default_rng(seed)
+        self.calls = []
+
+    def standard_normal(self, size=None):
+        self.calls.append(size)
+        return self.inner.standard_normal(size=size)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_complex_restart_jitter_draws_complex_noise():
+    from symbolicregression_jl_tpu.dataset import Dataset
+    from symbolicregression_jl_tpu.models.scorer import BatchScorer
+    from symbolicregression_jl_tpu.ops.constant_opt import (
+        optimize_constants_batched,
+    )
+    from symbolicregression_jl_tpu.tree import binary, constant, feature
+
+    rng0 = np.random.default_rng(0)
+    X = rng0.normal(size=(1, 32)).astype(np.complex64)
+    y = ((1 + 2j) * X[0] + (0.5 - 1j)).astype(np.complex64)
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=[],
+        dtype=np.complex64, optimizer_iterations=8, optimizer_nrestarts=2,
+        save_to_file=False,
+    )
+    ops = opts.operators
+    scorer = BatchScorer(Dataset(X, y), opts)
+    t = binary(
+        ops.binary_index("+"),
+        binary(ops.binary_index("*"), constant(1.0 + 0j), feature(0)),
+        constant(1.0 + 0j),
+    )
+    rec = _RecordingRNG(0)
+    new_trees, losses, improved = optimize_constants_batched(
+        [t], scorer, opts, rec
+    )
+    assert improved[0] and losses[0] < 1e-3
+    jitter_calls = [c for c in rec.calls if c is not None and len(c) == 3]
+    # complex dtype: TWO same-shape draws (real + imaginary components) so
+    # restarts cover phase as well as magnitude
+    assert len(jitter_calls) == 2, rec.calls
+    assert jitter_calls[0] == jitter_calls[1]
