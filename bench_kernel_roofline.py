@@ -114,5 +114,101 @@ def main():
     )
 
 
+def rows_sweep(P_sweep: int = 512):
+    """The last open roofline lever (VERDICT r4 task 4): kernel-true rate vs
+    dataset rows. Each (tree, slot) step pays scalar opcode dispatch ONCE per
+    row-tile loop — at R >> 10k the (8, C_TILE) row tiles per tree grow, so
+    the scalar-control overhead should amortize and VPU utilization recover.
+    Sweeps R with the same chain-K methodology as main(); P is held at 512
+    (the finalize/const-opt batch scale where big-R e2e searches live).
+
+    Emits one JSON line per R. Timing: loop_only (sync regime, slope of
+    time-vs-K); single runs, ±30% tunneled-TPU variance band."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        C_TILE,
+        P_TILE_LOSS,
+        _loss_pallas,
+        _reshape_rows,
+        pack_flat_fused,
+    )
+
+    rng = np.random.default_rng(0)
+    opts = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        maxsize=N,
+        save_to_file=False,
+    )
+    opset, loss_elem = opts.operators, opts.loss
+    trees = Population.random_trees(P_sweep, opts, 5, rng)
+    slots = float(np.mean([len(t.postorder()) for t in trees]))
+    flat = flatten_trees(trees, N)
+    ints, vals = pack_flat_fused(flat, opset)
+
+    rows_out = []
+    for R_s in (10_240, 65_536, 262_144, 1_048_576):
+        X = rng.normal(size=(5, R_s)).astype(np.float32)
+        y = np.cos(X[0]).astype(np.float32)
+        Xr, yr, wr, C, Rr = _reshape_rows(X, y, None)
+
+        def make_chain(K):
+            @jax.jit
+            def fK(ints, vals):
+                acc = jnp.zeros((P_sweep,), jnp.float32)
+                for k in range(K):
+                    v = vals + (k + 1) * 1e-7
+                    out = _loss_pallas(
+                        ints, v, Xr, yr, wr, opset, loss_elem,
+                        N, P_TILE_LOSS, C_TILE, C, Rr,
+                    )
+                    acc = acc + jnp.where(jnp.isfinite(out), out, 0.0)
+                return acc
+
+            return fK
+
+        _ = np.asarray(make_chain(1)(ints, vals))  # sync regime + compile
+        pts = []
+        for K in (1, 2, 4):
+            fK = make_chain(K)
+            _ = np.asarray(fK(ints, vals))
+            reps = 4
+            t0 = time.time()
+            for _i in range(reps):
+                _ = np.asarray(fK(ints, vals))
+            pts.append((K, (time.time() - t0) / reps))
+        ks = np.array([p[0] for p in pts], float)
+        ts = np.array([p[1] for p in pts], float)
+        A = np.vstack([ks, np.ones_like(ks)]).T
+        slope, intercept = np.linalg.lstsq(A, ts, rcond=None)[0]
+        evals_per_sec = P_sweep / slope
+        useful_flops = evals_per_sec * slots * R_s
+        row = {
+            "metric": "kernel_rate_vs_rows",
+            "n_rows": R_s,
+            "n_trees": P_sweep,
+            "row_tiles_per_tree": C // C_TILE,
+            "kernel_exec_ms_per_sweep": round(slope * 1000, 2),
+            "dispatch_overhead_ms": round(intercept * 1000, 1),
+            "tree_evals_per_sec": round(evals_per_sec, 0),
+            "row_evals_per_sec": round(evals_per_sec * R_s, 0),
+            "ns_per_tree_slot": round(slope / P_sweep / slots * 1e9, 2),
+            "vpu_utilization_true": round(useful_flops / V5E_VPU_FLOPS, 4),
+            "timing": "loop_only (chain-K slope, sync regime)",
+            "variance": "single run, ~±30% tunneled-TPU band (BASELINE.md)",
+        }
+        print(json.dumps(row), flush=True)
+        rows_out.append(row)
+    return rows_out
+
+
 if __name__ == "__main__":
-    main()
+    if "--rows-sweep" in sys.argv:
+        rows_sweep()
+    else:
+        main()

@@ -1,0 +1,131 @@
+"""Multi-host exchange cost model on the virtual mesh (VERDICT r4 task 6).
+
+The device engine's ONLY cross-host traffic is one allgather per iteration:
+the packed readback buffer + the topn-per-island migration pool
+(models/device_search.py; the reference ships whole pickled Populations
+through the head process instead,
+/root/reference/src/SymbolicRegression.jl:837-1064). This bench spawns
+2/4/8 REAL processes over jax.distributed (Gloo CPU collectives standing in
+for DCN — same harness as tests/test_multihost.py) with realistic search
+shapes, and measures:
+
+  - payload_bytes_in:  what one process contributes per iteration
+  - payload_bytes_out: what one process receives (contribution x processes)
+  - gather_ms_median / p90: measured wall per exchange (20 reps, warmed)
+
+Gloo over loopback is NOT DCN: absolute times are the virtual-mesh cost
+only; the payload column is exact and transport-independent. The scaling
+shape (payload_out = processes x payload_in; time ~ linear in payload_out at
+fixed process count) is the committed claim.
+
+Artifact: MULTIHOST_COST_r05.json (one JSON line per process count).
+Timing: loop_only (initialization + warmup excluded). Single runs,
+CPU-host variance applies.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+_WORKER = """
+import os, sys, time, json
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+from symbolicregression_jl_tpu.parallel.distributed import (
+    initialize, all_gather_migration_pool,
+)
+initialize(coordinator_address="localhost:{port}", num_processes=nproc, process_id=pid)
+
+import numpy as np
+from symbolicregression_jl_tpu import Options
+
+# realistic config-3-style shapes: 40 islands total, maxsize 20, topn 12
+options = Options(
+    binary_operators=["+", "-", "*", "/"], unary_operators=["cos", "exp", "abs"],
+    populations=40, population_size=33, maxsize=20, save_to_file=False,
+)
+I_local = max(1, options.populations // nproc)
+N = options.max_nodes
+S1 = options.maxsize + 1
+topn = min(options.topn, options.population_size)
+rows = I_local * topn
+
+# the per-iteration exchange payload, exactly as device_search builds it:
+# readback buffer (bs frontier + counters) + topn pool (6 int fields, val,
+# length, loss)
+buf = np.zeros((S1 * 3 + S1 * N * 6 + 2,), np.float32)
+pool = (
+    *(np.zeros((rows, N), np.int32) for _ in range(5)),
+    np.zeros((rows, N), np.float32),
+    np.zeros((rows,), np.int32),
+    np.zeros((rows,), np.float32),
+)
+payload_in = buf.nbytes + sum(a.nbytes for a in pool)
+
+# warm the collective path
+for _ in range(3):
+    all_gather_migration_pool((buf, *pool))
+
+times = []
+for _ in range(20):
+    t0 = time.perf_counter()
+    out = all_gather_migration_pool((buf, *pool))
+    times.append(time.perf_counter() - t0)
+times.sort()
+if pid == 0:
+    print(json.dumps({{
+        "metric": "multihost_exchange_cost",
+        "processes": nproc,
+        "islands_per_process": I_local,
+        "topn": topn,
+        "n_slots": N,
+        "maxsize": options.maxsize,
+        "payload_bytes_in": int(payload_in),
+        "payload_bytes_out": int(payload_in * nproc),
+        "gather_ms_median": round(1e3 * times[len(times) // 2], 2),
+        "gather_ms_p90": round(1e3 * times[int(len(times) * 0.9)], 2),
+        "transport": "gloo-cpu-loopback (virtual mesh; payload exact, time indicative)",
+        "timing": "loop_only (init + 3 warmup exchanges excluded)",
+    }}), flush=True)
+"""
+
+
+def run_one(nproc: int) -> dict:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    code = _WORKER.format(repo=REPO, port=port)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", code, str(pid), str(nproc)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        for pid in range(nproc)
+    ]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError(f"worker rc={p.returncode}\n{err[-2000:]}")
+    line = [ln for ln in outs[0][0].splitlines() if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def main():
+    rows = []
+    for nproc in (2, 4, 8):
+        r = run_one(nproc)
+        print(json.dumps(r), flush=True)
+        rows.append(r)
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,4 @@
-"""Scale north stars: BASELINE.md configs 4 and 5, measured (round 4).
+"""Scale north stars: BASELINE.md configs 4 and 5, measured (round 5).
 
 Config 4 — MultitargetSRRegressor, 5 outputs x 50k rows. The round-4
 concurrent-output scheduler (Options.parallel_outputs; search.py) runs the
@@ -7,18 +7,25 @@ and host decode/simplify overlap. The north-star bar (VERDICT r3 #5): the
 5-output fit's search-loop wall-clock must be < 2x a single-output search of
 the same TOTAL budget (1 output x 5x iterations).
 
-Config 5 — 1M rows. Two legs:
+Config 5 — 1M rows. Three legs:
   (a) scoring throughput: a 512-tree batch scored on the full 1M rows via
       the lockstep scorer's fast path (Pallas on TPU), sync-timed chain
       style (dispatch k, block on last) -> rows/s and tree-evals/s;
-  (b) end-to-end: a short lockstep search at 1M rows with minibatching
-      (batch_size 1024) + full-data finalize -> evals/s, best loss.
-On multi-device hosts the scorer's data_sharding="rows" path shards rows
-over the mesh with a psum loss reduction (parallel/sharding.py); on the
-single tunneled chip it runs the same code single-device (the 8-way
-correctness leg runs in tests/test_sharding.py on the virtual CPU mesh).
+  (b) end-to-end on the FLAGSHIP DEVICE ENGINE (round 5): in-engine
+      minibatching (fresh per-cycle row subsets), batch const-opt, and the
+      full-data finalize program, at a big-R-tuned population config —
+      data_sharding="rows" grows the engine mesh a 'rows' axis on
+      multi-device hosts (psum-combined scoring/const-opt/finalize;
+      single-device on the tunneled chip, 8-way leg in
+      tests/test_sharded_engine.py + dryrun_multichip);
+  (c) end-to-end lockstep at the round-4 config, for comparison.
 
-Artifact: BENCH_SCALE_r04.json. Run on an idle host.
+Timing hygiene (VERDICT r4 #7): every row carries a "timing" field —
+"loop_only" excludes compiles/setup (the honest steady-state denominator),
+"includes_compile" does not. All numbers carry the documented ~±30%
+tunneled-TPU variance band (BASELINE.md); single runs, not medians.
+
+Artifact: BENCH_SCALE_r05.json. Run on an idle host.
 """
 
 import json
@@ -82,11 +89,13 @@ def config4_multitarget(niters: int = 4):
             round(min(m.loss for m in r.pareto_frontier), 6) for r in res5
         ],
         "total_evals": round(sum(r.num_evals for r in res5), 0),
-        "note": (
-            "ratio < 2.0 = concurrent scheduling beats serial re-runs; "
-            "wall includes per-output engine compiles (AOT-cached within a "
-            "process), loop_s is the honest steady-state number"
+        "timing": (
+            "wall_s includes_compile (per-output engine compiles, AOT-cached "
+            "within a process); loop_s is loop_only, the honest steady-state "
+            "number"
         ),
+        "variance": "single run, ~±30% tunneled-TPU band (BASELINE.md)",
+        "note": "ratio < 2.0 = concurrent scheduling beats serial re-runs",
     }
 
 
@@ -133,12 +142,12 @@ def config5_scoring_throughput(n_rows: int = 1_000_000, n_trees: int = 512):
             float(np.mean([np.isfinite(l).mean() for l in losses])), 3
         ),
         "sharded_path": scorer._sharded is not None,
+        "timing": "loop_only (warmup call excluded, chain-timed)",
+        "variance": "single run, ~±30% tunneled-TPU band (BASELINE.md)",
     }
 
 
-def config5_e2e_search(n_rows: int = 1_000_000, niters: int = 2):
-    from symbolicregression_jl_tpu import Options, equation_search
-
+def _config5_problem(n_rows: int):
     rng = np.random.default_rng(0)
     X = rng.normal(size=(5, n_rows)).astype(np.float32)
     y = (
@@ -146,6 +155,59 @@ def config5_e2e_search(n_rows: int = 1_000_000, niters: int = 2):
         + 0.5 * X[1] * np.abs(X[2]) ** 0.9
         - 0.3 * np.abs(X[3]) ** 1.5
     ).astype(np.float32)
+    return X, y
+
+
+def config5_e2e_search(n_rows: int = 1_000_000, niters: int = 4):
+    """1M-row end-to-end search ON THE DEVICE ENGINE (VERDICT r4 task 1) —
+    populations sized for big R (fixed costs per iteration amortize over a
+    4096-member full-data finalize), reference-ordered batch const-opt +
+    finalize (/root/reference/src/SingleIteration.jl:107-132)."""
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    X, y = _config5_problem(n_rows)
+    options = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        populations=32,
+        population_size=128,
+        ncycles_per_iteration=100,
+        maxsize=20,
+        batching=True,
+        batch_size=1024,
+        data_sharding="rows",
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    t0 = time.time()
+    res = equation_search(X, y, options=options, niterations=niters, verbosity=0)
+    wall = time.time() - t0
+    rate = res.num_evals / max(res.iteration_seconds, 1e-9)
+    return {
+        "metric": "config5_e2e_1M_rows",
+        "scheduler": "device",
+        "populations_x_size": "32x128",
+        "n_rows": n_rows,
+        "niterations": niters,
+        "wall_s": round(wall, 1),
+        "loop_s": round(res.iteration_seconds, 1),
+        "num_evals": round(res.num_evals, 0),
+        "evals_per_s_loop": round(rate, 1),
+        "vs_r4_lockstep_90p8": round(rate / 90.8, 1),
+        "best_loss": round(min(m.loss for m in res.pareto_frontier), 6),
+        "baseline_loss": round(res.dataset.baseline_loss, 6),
+        "timing": "loop_s/evals_per_s are loop_only; wall_s includes_compile",
+        "variance": "single run, ~±30% tunneled-TPU band (BASELINE.md)",
+    }
+
+
+def config5_e2e_lockstep(n_rows: int = 1_000_000, niters: int = 2):
+    """Round-4 lockstep leg, re-measured for comparison (same config as
+    BENCH_SCALE_r04's config5_e2e row)."""
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    X, y = _config5_problem(n_rows)
     options = Options(
         binary_operators=["+", "-", "*", "/"],
         unary_operators=["cos", "exp", "abs"],
@@ -158,12 +220,15 @@ def config5_e2e_search(n_rows: int = 1_000_000, niters: int = 2):
         data_sharding="rows",
         save_to_file=False,
         seed=0,
+        scheduler="lockstep",
     )
     t0 = time.time()
     res = equation_search(X, y, options=options, niterations=niters, verbosity=0)
     wall = time.time() - t0
     return {
-        "metric": "config5_e2e_1M_rows",
+        "metric": "config5_e2e_1M_rows_lockstep_comparison",
+        "scheduler": "lockstep",
+        "populations_x_size": "10x33",
         "n_rows": n_rows,
         "niterations": niters,
         "wall_s": round(wall, 1),
@@ -172,10 +237,12 @@ def config5_e2e_search(n_rows: int = 1_000_000, niters: int = 2):
         "evals_per_s_loop": round(res.num_evals / max(res.iteration_seconds, 1e-9), 1),
         "best_loss": round(min(m.loss for m in res.pareto_frontier), 6),
         "baseline_loss": round(res.dataset.baseline_loss, 6),
+        "timing": "loop_s/evals_per_s are loop_only; wall_s includes_compile",
+        "variance": "single run, ~±30% tunneled-TPU band (BASELINE.md)",
     }
 
 
-def main(which=("c5score", "c5e2e", "c4")):
+def main(which=("c5score", "c5e2e", "c5lock", "c4")):
     out = []
     if "c5score" in which:
         r = config5_scoring_throughput()
@@ -183,6 +250,10 @@ def main(which=("c5score", "c5e2e", "c4")):
         out.append(r)
     if "c5e2e" in which:
         r = config5_e2e_search()
+        print(json.dumps(r), flush=True)
+        out.append(r)
+    if "c5lock" in which:
+        r = config5_e2e_lockstep()
         print(json.dumps(r), flush=True)
         out.append(r)
     if "c4" in which:
@@ -196,6 +267,6 @@ if __name__ == "__main__":
     import sys
 
     which = tuple(a for a in sys.argv[1:] if not a.startswith("--")) or (
-        "c5score", "c5e2e", "c4"
+        "c5score", "c5e2e", "c5lock", "c4"
     )
     main(which)

@@ -24,6 +24,34 @@ from .ops import (
     flatten_trees,
     resolve_operators,
 )
+# loss zoo re-exports (the reference re-exports the LossFunctions.jl names,
+# /root/reference/src/SymbolicRegression.jl:101-127) — both the concrete
+# losses and the parameterized factories (LPDistLoss(p), HuberLoss(d), ...)
+# are importable from the package root and accepted by
+# Options(elementwise_loss=...), by object or by string ("LPDistLoss(3)").
+from .ops.losses import (
+    DWDMarginLoss,
+    ExpLoss,
+    HuberLoss,
+    L1DistLoss,
+    L1EpsilonInsLoss,
+    L1HingeLoss,
+    L2DistLoss,
+    L2EpsilonInsLoss,
+    L2HingeLoss,
+    L2MarginLoss,
+    LogCoshLoss,
+    LogitDistLoss,
+    LogitMarginLoss,
+    LPDistLoss,
+    ModifiedHuberLoss,
+    PerceptronLoss,
+    PeriodicLoss,
+    QuantileLoss,
+    SigmoidLoss,
+    SmoothedL1HingeLoss,
+    ZeroOneLoss,
+)
 from .utils.checkpoint import load_saved_state
 
 __version__ = "0.1.0"
@@ -50,5 +78,26 @@ __all__ = [
     "flatten_trees",
     "resolve_operators",
     "load_saved_state",
+    "DWDMarginLoss",
+    "ExpLoss",
+    "HuberLoss",
+    "L1DistLoss",
+    "L1EpsilonInsLoss",
+    "L1HingeLoss",
+    "L2DistLoss",
+    "L2EpsilonInsLoss",
+    "L2HingeLoss",
+    "L2MarginLoss",
+    "LogCoshLoss",
+    "LogitDistLoss",
+    "LogitMarginLoss",
+    "LPDistLoss",
+    "ModifiedHuberLoss",
+    "PerceptronLoss",
+    "PeriodicLoss",
+    "QuantileLoss",
+    "SigmoidLoss",
+    "SmoothedL1HingeLoss",
+    "ZeroOneLoss",
     "__version__",
 ]

@@ -45,23 +45,32 @@ from .population import Population
 __all__ = ["device_search_one_output", "device_mode_supported", "build_evo_config"]
 
 
-def device_mode_supported(options: Options, dataset: Dataset | None = None) -> str | None:
+def device_mode_supported(options: Options) -> str | None:
     """None if the device engine can honor this configuration; else a reason
-    string (callers fall back to the host lockstep engine or raise)."""
+    string (callers fall back to the host lockstep engine or raise). The
+    answer depends only on Options now — round 5 removed the last
+    dataset-dependent exclusions (units run in-jit, rows sharding grows the
+    engine mesh)."""
     if options.loss_function is not None:
         return "custom full-objective loss_function"
     if options.complexity_mapping is not None:
         return "custom complexity mapping"
-    if options.data_sharding is not None:
-        return "dataset row sharding"
-    if dataset is not None and dataset.has_units:
-        return "dimensional analysis (units)"
+    # data_sharding="rows" is honored: on multi-device hosts the engine mesh
+    # grows a 'rows' axis (psum-combined scoring + const-opt); on one device
+    # all rows are local anyway. Units are honored too (round 5): the engine
+    # runs the WildcardQuantity abstract eval in-jit (ops/evolve._dim_violates)
+    # with the additive dimensional-regularization penalty.
     if options.use_recorder:
         return "recorder (mutation lineage tracing)"
     if options.graph_nodes:
         return "GraphNode shared-subtree DAGs"
-    if np.dtype(options.dtype) != np.float32:
-        return "non-float32 compute dtype"
+    # f32 AND f64 are engine dtypes (the reference defaults to Float64,
+    # /root/reference/src/SymbolicRegression.jl:360-447): f64 runs the
+    # scan-interpreter scorer under jax_enable_x64 with f64 state arrays.
+    # Complex stays CPU-committed on the host engines (XLA:TPU has no
+    # complex arithmetic; utils/precision.py).
+    if np.dtype(options.dtype) not in (np.dtype(np.float32), np.dtype(np.float64)):
+        return f"unsupported engine dtype {np.dtype(options.dtype).name}"
     return None
 
 
@@ -73,6 +82,7 @@ def build_evo_config(
     niterations: int,
     n_islands: int | None = None,
     n_rows: int | None = None,
+    dataset: Dataset | None = None,
 ) -> EvoConfig:
     """Translate Options into the device engine's static EvoConfig.
     ``n_islands`` overrides options.populations (per-shard configs in the
@@ -158,6 +168,53 @@ def build_evo_config(
             if options.batching and n_rows
             else 1.0
         ),
+        val_dtype=str(np.dtype(options.dtype)),
+        **_units_config(options, dataset, n_features),
+    )
+
+
+_DIM_BASES = (
+    "length", "mass", "time", "current", "temperature", "luminosity", "amount"
+)
+#: power-like unary ops: output dims = input dims * p (wildcard preserved)
+_UNA_DIM_POWERS = {
+    "sqrt": 0.5, "sqrt_abs": 0.5, "cbrt": 1.0 / 3.0, "abs": 1.0, "neg": 1.0,
+    "square": 2.0, "cube": 3.0, "inv": -1.0,
+}
+#: binary dim-combination codes: 0 add/sub, 1 mult, 2 div, 3 generic/pow
+_BIN_DIM_CODES = {"add": 0, "sub": 0, "mult": 1, "div": 2}
+
+
+def _units_config(options: Options, dataset, n_features: int) -> dict:
+    """EvoConfig units fields (static tables) from the dataset's parsed SI
+    units + the operator names; empty when the dataset carries no units."""
+    if dataset is None or not getattr(dataset, "has_units", False):
+        return {}
+    from ..units import DIMENSIONLESS, Quantity
+
+    def dim_row(dims):
+        return tuple(float(getattr(dims, b)) for b in _DIM_BASES)
+
+    xq = getattr(dataset, "X_units_parsed", None)
+    if xq is None:
+        xq = [Quantity(1.0, DIMENSIONLESS)] * n_features
+    yq = getattr(dataset, "y_units_parsed", None)
+    return dict(
+        units_check=True,
+        x_dims=tuple(dim_row(q.dims) for q in xq),
+        y_dims=dim_row(yq.dims) if yq is not None else None,
+        una_dim_pow=tuple(
+            _UNA_DIM_POWERS.get(op.name) for op in options.operators.unary
+        ),
+        bin_dim_code=tuple(
+            _BIN_DIM_CODES.get(op.name, 3) for op in options.operators.binary
+        ),
+        dim_penalty=(
+            1000.0
+            if options.dimensional_constraint_penalty is None
+            else float(options.dimensional_constraint_penalty)
+        ),
+        allow_wildcards=not options.dimensionless_constants_only,
     )
 
 
@@ -190,6 +247,7 @@ def _dataset_key(X, y, weights):
 def _make_score_fn(
     X, y, weights, options: Options, use_pallas: bool, ds_key=None,
     norm: float = 1.0, need_raw: bool = True,
+    rows_axis: str | None = None, rows_shards: int = 1, mesh=None,
 ):
     """Build the in-graph scoring closure + its dataset pytree.
 
@@ -200,7 +258,13 @@ def _make_score_fn(
     dataset of the same shape (multi-output fits, warm starts). score_fn and
     its jitted wrapper (``score_fn.jitted``) are memoized on the static
     shape/config key; ``data`` is memoized on the dataset bytes (device
-    uploads cost ~100ms each on this backend)."""
+    uploads cost ~100ms each on this backend).
+
+    ``rows_axis``/``rows_shards``/``mesh``: rows-sharded mode — score_fn
+    must run inside shard_map over ``mesh`` (it psums over ``rows_axis``),
+    ``data`` is built with each shard's row block packed independently and
+    placed with a rows NamedSharding, and no ``.jitted`` wrapper is attached
+    (callers wrap in shard_map themselves)."""
     has_w = weights is not None
     fn_key = (
         options.operators,
@@ -210,14 +274,21 @@ def _make_score_fn(
         options.batching and options.batch_size,
         X.shape,
         has_w,
+        rows_axis,
+        rows_shards,
     )
     with _CACHE_LOCK:
         fn = _SCORE_FN_CACHE.get(fn_key)
     if fn is None:
-        fn = _build_score_fn(options, use_pallas, X.shape[0], X.shape[1], has_w)
-        import jax
+        n_local = X.shape[1] // rows_shards if rows_shards > 1 else X.shape[1]
+        fn = _build_score_fn(
+            options, use_pallas, X.shape[0], n_local, has_w,
+            rows_axis=rows_axis, rows_shards=rows_shards,
+        )
+        if rows_axis is None:
+            import jax
 
-        fn.jitted = jax.jit(fn)
+            fn.jitted = jax.jit(fn)
         with _CACHE_LOCK:
             if len(_SCORE_FN_CACHE) >= 12:
                 _SCORE_FN_CACHE.pop(next(iter(_SCORE_FN_CACHE)))
@@ -228,13 +299,19 @@ def _make_score_fn(
         use_pallas,
         need_raw,
         float(norm),  # baseline depends on the LOSS, not just the data bytes
+        rows_shards,
     )
     with _CACHE_LOCK:
         data = _SCORE_DATA_CACHE.get(d_key)
     if data is None:
-        data = _make_score_data(
-            X, y, weights, use_pallas, norm=norm, need_raw=need_raw
-        )
+        if rows_shards > 1:
+            data = _make_score_data_rows(
+                X, y, weights, mesh, use_pallas, norm=norm, need_raw=need_raw
+            )
+        else:
+            data = _make_score_data(
+                X, y, weights, use_pallas, norm=norm, need_raw=need_raw
+            )
         with _CACHE_LOCK:
             if len(_SCORE_DATA_CACHE) >= 12:  # bound device-array retention
                 _SCORE_DATA_CACHE.pop(next(iter(_SCORE_DATA_CACHE)))
@@ -274,29 +351,137 @@ def _make_score_data(
         Xr, yr, wr, _, _ = _reshape_rows(X, y, weights)
         kw.update(Xr=Xr, yr=yr, wr=wr)
     if need_raw or not use_pallas:
+        # preserve the caller's dtype (f64 engines upload f64 data; the
+        # Pallas packed fields above are f32-only by construction)
         kw.update(
-            Xd=jnp.asarray(X, jnp.float32),
-            yd=jnp.asarray(y, jnp.float32),
-            wd=jnp.asarray(weights, jnp.float32) if has_w else None,
+            Xd=jnp.asarray(X),
+            yd=jnp.asarray(y),
+            wd=jnp.asarray(weights) if has_w else None,
         )
-    kw.update(norm=jnp.asarray(norm, jnp.float32))
+    kw.update(norm=jnp.asarray(norm, np.dtype(X.dtype)))
     return ScoreData(**kw)
 
 
+def _make_score_data_rows(
+    X, y, weights, mesh, use_pallas: bool, norm: float = 1.0,
+    need_raw: bool = True,
+) -> ScoreData:
+    """Rows-sharded ScoreData over ``mesh``'s 'rows' axis. Each shard's row
+    block is packed INDEPENDENTLY (per-block kernel pad with w=0 masking, so
+    every shard runs the identical static-C program), then the blocks
+    concatenate along the packed column axis and land with a
+    PartitionSpec(None, 'rows') placement — shard s gets exactly its own
+    pack. Requires n_rows divisible by the rows-axis size (the caller
+    chooses the axis under that constraint)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_sh = mesh.shape["rows"]
+    F, R = X.shape
+    assert R % n_sh == 0, (R, n_sh)
+    R_local = R // n_sh
+    has_w = weights is not None
+
+    def put(a, spec):
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    kw = {}
+    if use_pallas:
+        from ..ops.interp_pallas import pack_rows_np
+
+        packs = [
+            pack_rows_np(
+                X[:, s * R_local : (s + 1) * R_local],
+                y[s * R_local : (s + 1) * R_local],
+                None
+                if weights is None
+                else weights[s * R_local : (s + 1) * R_local],
+            )
+            for s in range(n_sh)
+        ]
+        kw.update(
+            Xr=put(np.concatenate([p[0] for p in packs], axis=1), P(None, "rows")),
+            yr=put(np.concatenate([p[1] for p in packs], axis=1), P(None, "rows")),
+            wr=put(np.concatenate([p[2] for p in packs], axis=1), P(None, "rows")),
+        )
+    if need_raw or not use_pallas:
+        kw.update(
+            Xd=put(np.asarray(X), P(None, "rows")),
+            yd=put(np.asarray(y), P("rows")),
+            wd=put(np.asarray(weights), P("rows")) if has_w else None,
+        )
+    kw.update(norm=put(np.asarray(norm, np.dtype(X.dtype)), P()))
+    return ScoreData(**kw)
+
+
+def score_data_specs(data: ScoreData) -> ScoreData:
+    """shard_map PartitionSpecs matching a rows-sharded ScoreData (None
+    fields stay None — empty pytree leaves)."""
+    from jax.sharding import PartitionSpec as P
+
+    return ScoreData(
+        Xr=None if data.Xr is None else P(None, "rows"),
+        yr=None if data.yr is None else P(None, "rows"),
+        wr=None if data.wr is None else P(None, "rows"),
+        Xd=None if data.Xd is None else P(None, "rows"),
+        yd=None if data.yd is None else P("rows"),
+        wd=None if data.wd is None else P("rows"),
+        norm=P(),
+    )
+
+
 def _build_score_fn(
-    options: Options, use_pallas: bool, n_features: int, n_rows: int, has_w: bool
+    options: Options, use_pallas: bool, n_features: int, n_rows: int,
+    has_w: bool, rows_axis: str | None = None, rows_shards: int = 1,
 ):
     """Score closure: (batch [B, N], data[, key]) -> losses [B]. When
     options.batching, the 3-arg form scores a fresh with-replacement row
     subset of batch_size (reference: batch_sample + eval_loss_batched,
     /root/reference/src/LossFunctions.jl:114-127); the 2-arg form always
-    scores full data (finalize path)."""
+    scores full data (finalize path).
+
+    ``rows_axis``: dataset-row sharding over a mesh axis of that name
+    (SURVEY §5.7 / the reference's row-parallel loss,
+    /root/reference/src/LossFunctions.jl:114-127 scaled out). ``n_rows`` is
+    then the PER-SHARD row count and the closure must run inside shard_map:
+    each shard scores its local row block and the weighted means combine
+    with a single scalar-pair psum over ICI — predictions never move. The
+    minibatch form draws batch_size/rows_shards local rows per shard
+    (decorrelated via an axis-index key fold) so the effective fresh-subset
+    size stays batch_size."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     opset, loss_elem = options.operators, options.loss
     N = options.max_nodes
-    bs = min(int(options.batch_size), n_rows) if options.batching else None
+    bs = None
+    if options.batching:
+        bs_total = min(int(options.batch_size), n_rows * rows_shards)
+        bs = max(1, bs_total // rows_shards)
+
+    def _combine(local, wsum):
+        """Merge per-shard weighted-mean losses into the global weighted
+        mean: psum(mean*wsum)/psum(wsum). Exact for weighted and unequal
+        shards; inf/nan propagate (an invalid tree on ANY shard is invalid
+        globally, matching the single-device all-rows semantics)."""
+        if rows_axis is None:
+            return local
+        num = lax.psum(local * wsum, rows_axis)
+        den = lax.psum(wsum, rows_axis)
+        return num / jnp.maximum(den, 1e-30)
+
+    def _fold_rows(key):
+        # decorrelate per-shard minibatch draws; deterministic per shard
+        if rows_axis is None:
+            return key
+        return jax.random.fold_in(key, lax.axis_index(rows_axis))
+
+    def _batch_wsum(data, idx):
+        if has_w:
+            return jnp.sum(data.wd[idx])
+        return jnp.asarray(float(bs), jnp.float32)
 
     if use_pallas:
         from ..ops.interp_pallas import (
@@ -332,13 +517,19 @@ def _build_score_fn(
                     ints, vals, data.Xr, data.yr, data.wr, opset, loss_elem,
                     N, P_TILE_LOSS, C_TILE, C, n_rows,
                 )
+                # wr is 0 on pad rows and the true weight (1 unweighted) on
+                # real rows, so its sum IS this shard's weight total
+                out = _combine(out, jnp.sum(data.wr))
             else:
-                idx = jax.random.choice(key, n_rows, (bs,), replace=True)
+                idx = jax.random.choice(
+                    _fold_rows(key), n_rows, (bs,), replace=True
+                )
                 out = _loss_pallas_dyn(
                     ints, vals, data.Xd[:, idx], data.yd[idx],
                     data.wd[idx] if has_w else jnp.zeros((), jnp.float32),
                     opset, loss_elem, N, has_w, bs,
                 )
+                out = _combine(out, _batch_wsum(data, idx))
             return out[:B]
 
         return score_fn
@@ -350,26 +541,33 @@ def _build_score_fn(
     def score_fn(batch, data: ScoreData, key=None):
         flat = FlatTrees(
             batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat,
-            batch.val.astype(jnp.float32), batch.length,
+            batch.val.astype(data.Xd.dtype), batch.length,
         )
         if key is None:
             Xs, ys, ws = data.Xd, data.yd, data.wd
+            wsum = (
+                jnp.sum(data.wd)
+                if has_w
+                else jnp.asarray(float(n_rows), jnp.float32)
+            )
         else:
-            import jax
-
-            idx = jax.random.choice(key, n_rows, (bs,), replace=True)
+            idx = jax.random.choice(_fold_rows(key), n_rows, (bs,), replace=True)
             Xs, ys = data.Xd[:, idx], data.yd[idx]
             ws = None if data.wd is None else data.wd[idx]
+            wsum = _batch_wsum(data, idx)
         preds = eval_trees(flat, Xs, opset)
         elem = loss_elem(preds, ys[None, :])
         losses = weighted_mean_loss(elem, None if ws is None else ws[None, :])
         ok = jnp.isfinite(preds).all(axis=-1)
-        return jnp.where(ok, losses, jnp.inf)
+        return _combine(jnp.where(ok, losses, jnp.inf), wsum)
 
     return score_fn
 
 
-def _make_const_opt_fn(options: Options, cfg: EvoConfig, has_w: bool, axis=None):
+def _make_const_opt_fn(
+    options: Options, cfg: EvoConfig, has_w: bool, axis=None, rows_axis=None,
+    batch_rows: int | None = None,
+):
     """Jitted per-iteration constant optimization over a fixed-size random
     member subset, fully device-side (selection, BFGS, accept, scatter-back).
     Reference semantics: optimize with prob optimizer_probability per member,
@@ -379,7 +577,18 @@ def _make_const_opt_fn(options: Options, cfg: EvoConfig, has_w: bool, axis=None)
     ``axis``: island-sharded shard_map mode — ``cfg`` is then the PER-SHARD
     config (local island count) and each shard optimizes its own K members;
     see _select_and_jitter for the key discipline. Returns the UNJITTED impl
-    in that mode (the caller wraps it in shard_map + jit)."""
+    in that mode (the caller wraps it in shard_map + jit).
+
+    ``rows_axis``: dataset rows sharded over that mesh axis — every loss and
+    gradient the BFGS sees is psum-combined across rows shards (the linear
+    ``combine`` hook of _bfgs_single), so the rows-replicated population
+    state advances identically on every shard.
+
+    ``batch_rows``: cfg.batching — optimize against one fresh per-call row
+    subset with batch-vs-batch acceptance and fractional eval accounting
+    (reference batch-sample optimization,
+    /root/reference/src/ConstantOptimization.jl:13-21,44-78); the finalize
+    program restores full-data losses right after."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -410,11 +619,34 @@ def _make_const_opt_fn(options: Options, cfg: EvoConfig, has_w: bool, axis=None)
     K = n_chunks * chunk
 
     def const_opt(state: EvoState, data) -> EvoState:
-        Xd, yd = data.Xd, data.yd
-        wd = data.wd if has_w else jnp.zeros((), jnp.float32)
+        if batch_rows is None:
+            Xd, yd = data.Xd, data.yd
+            wd = data.wd if has_w else jnp.zeros((), jnp.float32)
+        else:
+            k_idx = jax.random.fold_in(state.key, 0xBA7C)
+            if rows_axis is not None:
+                k_idx = jax.random.fold_in(k_idx, lax.axis_index(rows_axis))
+            idx = jax.random.choice(
+                k_idx, data.Xd.shape[1], (batch_rows,), replace=True
+            )
+            Xd, yd = data.Xd[:, idx], data.yd[idx]
+            wd = data.wd[idx] if has_w else jnp.zeros((), jnp.float32)
         # closures over traced args are trace-safe; building them here keeps
         # the executable dataset-independent
         loss_fn = remat_tree_loss(opset, loss_elem, Xd, yd, wd, has_w)
+        combine = None
+        if rows_axis is not None:
+            wsum = (
+                jnp.sum(wd)
+                if has_w
+                else jnp.asarray(float(Xd.shape[1]), jnp.float32)
+            )
+
+            def combine(x):  # noqa: E731 — global weighted mean of shard pieces
+                return lax.psum(x * wsum, rows_axis) / jnp.maximum(
+                    lax.psum(wsum, rows_axis), 1e-30
+                )
+
         key, ii, pp, val0, mask, starts = _select_and_jitter(
             state, K, S, I, P, axis=axis
         )
@@ -430,7 +662,8 @@ def _make_const_opt_fn(options: Options, cfg: EvoConfig, has_w: bool, axis=None)
         def per_tree(struct_p, starts_p, mask_p):
             def per_restart(v0):
                 return _bfgs_single(
-                    loss_fn, v0, struct_p, Xd, yd, wd, has_w, mask_p, iters
+                    loss_fn, v0, struct_p, Xd, yd, wd, has_w, mask_p, iters,
+                    combine=combine,
                 )
 
             vals, fs = jax.vmap(per_restart)(starts_p)
@@ -448,9 +681,42 @@ def _make_const_opt_fn(options: Options, cfg: EvoConfig, has_w: bool, axis=None)
         vals, fs = lax.map(per_chunk, chunked)
         vals = vals.reshape((K,) + vals.shape[2:])
         fs = fs.reshape((K,))
+        if cfg.units_check:
+            # const-opt never changes structure, so the dimensional penalty
+            # is constant per tree: add it to every loss the accept rule
+            # compares, keeping stored (penalized) losses consistent
+            from ..ops.evolve import dim_penalty_batch
+            from ..ops.treeops import Tree as _Tree
+
+            pen_k = dim_penalty_batch(
+                _Tree(
+                    structure.kind, structure.op, structure.lhs,
+                    structure.rhs, structure.feat, val0, structure.length,
+                ),
+                cfg,
+            )
+            fs = fs + pen_k
+        n_ev = K * S * 2 * iters
+        base = None
+        if batch_rows is not None:
+            # batch-vs-batch accept + fractional evals (reference
+            # ConstantOptimization.jl:44-78,47); combine keeps the base
+            # replicated across rows shards like every other loss
+            # NB: _bfgs_single evaluates this same f(val0) internally as its
+            # entry point but does not return it; the duplicate is one
+            # K x batch_rows minibatch eval per call on this (non-Pallas
+            # fallback) path — small next to the BFGS's 8x(1+ls) evals, and
+            # not worth widening the shared _bfgs_single return contract
+            f0 = jax.vmap(
+                lambda v, s: loss_fn(v, s, Xd, yd, wd, has_w)
+            )(val0, structure)
+            base = f0 if combine is None else combine(f0)
+            if cfg.units_check:
+                base = base + pen_k
+            n_ev = n_ev * cfg.eval_fraction
         return _accept_and_scatter(
-            state, cfg, key, ii, pp, mask, val0, vals, fs, K * S * 2 * iters,
-            axis=axis, norm=data.norm,
+            state, cfg, key, ii, pp, mask, val0, vals, fs, n_ev,
+            axis=axis, norm=data.norm, base_loss=base,
         )
 
     return const_opt if axis is not None else jax.jit(const_opt)
@@ -476,17 +742,17 @@ def _select_and_jitter(state: EvoState, K: int, S: int, I: int, P: int, axis=Non
     flat_idx = jax.random.permutation(k_sel, I * P)[:K]
     ii, pp = flat_idx // P, flat_idx % P
     kind = state.kind[ii, pp]
-    val0 = state.val[ii, pp].astype(jnp.float32)
+    val0 = state.val[ii, pp]  # engine dtype (f32 or f64)
     mask = kind == KIND_CONST
     N = val0.shape[1]
-    jitter = 1.0 + 0.5 * jax.random.normal(k_jit, (K, S - 1, N), dtype=jnp.float32)
+    jitter = 1.0 + 0.5 * jax.random.normal(k_jit, (K, S - 1, N), dtype=val0.dtype)
     starts = jnp.concatenate([val0[:, None, :], val0[:, None, :] * jitter], axis=1)
     return key, ii, pp, val0, mask, starts
 
 
 def _accept_and_scatter(
     state: EvoState, cfg: EvoConfig, key, ii, pp, mask_k, val0, vals, fbest,
-    n_evals: int, axis=None, norm=None,
+    n_evals, axis=None, norm=None, base_loss=None,
 ):
     """Shared const-opt back half: accept only improvements, scatter new
     constants/losses/scores back, reset birth (reference accept rule,
@@ -494,7 +760,14 @@ def _accept_and_scatter(
 
     ``axis``: shard_map mode — n_evals counts one shard's work so the
     replicated counter advances by the psum; the stored key is re-derived
-    from the replicated entry key (the passed one is shard-divergent)."""
+    from the replicated entry key (the passed one is shard-divergent).
+
+    ``base_loss``: batch mode (cfg.batching) — fbest is a minibatch loss, so
+    it must compare against the member's loss ON THE SAME BATCH (the
+    reference optimizes and accepts on one batch sample,
+    /root/reference/src/ConstantOptimization.jl:44-78); the accepted batch
+    loss lands in state and the finalize program immediately rescores on
+    full data. Default None compares against the stored (full-data) loss."""
     import jax.numpy as jnp
 
     n_evals = jnp.asarray(n_evals, jnp.float32)
@@ -506,19 +779,26 @@ def _accept_and_scatter(
         key = jax.random.fold_in(state.key, 0x0C07)
 
     old_loss = state.loss[ii, pp]
+    base = old_loss if base_loss is None else base_loss
     has_consts = jnp.any(mask_k, axis=1)
-    improved = (fbest < old_loss) & has_consts
+    improved = (fbest < base) & has_consts
     new_val = jnp.where(improved[:, None], vals, val0)
     new_loss = jnp.where(improved, fbest, old_loss)
     comp = state.length[ii, pp].astype(jnp.float32)
     new_score = _score_of(new_loss, comp, cfg, norm)
-    if cfg.copt_updates_bs:
+    if cfg.copt_updates_bs and not cfg.batching:
         # Fold the tuned members into the best-seen frontier. Without this,
         # optimized constants lived only in the population: the in-jit hof
         # migration spread UNtuned bs trees and the per-iteration readback
         # under-reported the front (the reference's optimize step feeds the
         # hall of fame via finalize_scores + update_hall_of_fame!,
         # /root/reference/src/SingleIteration.jl:107-174 + main loop :916-926).
+        # Under cfg.batching the losses here are BATCH losses and must NOT
+        # touch the frontier — a lucky draw could evict a genuinely better
+        # tree that finalize cannot restore; the finalize program that runs
+        # right after const-opt merges the tuned population on exact
+        # full-data losses instead (reference: hall of fame is fed only
+        # post-finalize).
         from ..ops.evolve import merge_best_seen
 
         lengths = state.length[ii, pp]
@@ -543,7 +823,8 @@ def _accept_and_scatter(
 
 
 def _make_const_opt_fn_pallas(
-    options: Options, cfg: EvoConfig, n_rows: int, has_w: bool, axis=None
+    options: Options, cfg: EvoConfig, n_rows: int, has_w: bool, axis=None,
+    rows_axis=None, batch_rows: int | None = None,
 ):
     """Constant optimization through the fused Pallas loss+grad kernel
     (ops/interp_pallas._loss_grad_pallas): the whole (member, restart) batch
@@ -556,7 +837,19 @@ def _make_const_opt_fn_pallas(
     this path runs BFGS for every tree — on a 1-D problem BFGS's first
     curvature update is the same secant estimate Newton's backtracking
     protects, and the accept-only-if-improved rule bounds any difference.
-    """
+
+    ``n_rows`` is the PER-SHARD row count when ``rows_axis`` is set: the
+    kernels score this shard's block and every loss/grad the lockstep BFGS
+    consumes is psum-combined across rows shards (the weighted-mean
+    combination — losses and gradient components merge with the same linear
+    map), keeping the rows-replicated state bitwise consistent.
+
+    ``batch_rows``: cfg.batching — the whole BFGS runs against ONE fresh
+    per-call row subset of this (per-shard) size, gathered and packed
+    in-graph, exactly the reference's batch-sample optimization
+    (/root/reference/src/ConstantOptimization.jl:13-21); acceptance compares
+    batch-vs-batch (base_loss) and evals count fractionally. The finalize
+    program that follows restores full-data losses."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -578,23 +871,60 @@ def _make_const_opt_fn_pallas(
     iters = int(options.optimizer_iterations)
     opset, loss_elem = options.operators, options.loss
     Lv = _round_up(N, 128)
-    C = _round_up(n_rows, 8 * C_TILE) // 8
+    R_eff = n_rows if batch_rows is None else batch_rows
+    C = _round_up(R_eff, 8 * C_TILE) // 8
+    F = cfg.nfeatures
 
     def const_opt(state: EvoState, data) -> EvoState:
         # kernel calls take the packed dataset from the traced `data` arg —
         # the compiled const-opt executable is dataset-independent
+        if batch_rows is None:
+            Xr, yr, wr = data.Xr, data.yr, data.wr
+            shard_w = jnp.sum(data.wr)
+        else:
+            k_idx = jax.random.fold_in(state.key, 0xBA7C)
+            if rows_axis is not None:
+                k_idx = jax.random.fold_in(k_idx, lax.axis_index(rows_axis))
+            idx = jax.random.choice(k_idx, n_rows, (batch_rows,), replace=True)
+            R_pad = _round_up(batch_rows, 8 * C_TILE)
+            Xr = jnp.pad(
+                data.Xd[:, idx], ((0, 0), (0, R_pad - batch_rows)),
+                constant_values=1.0,
+            ).reshape(F * 8, C)
+            yr = jnp.pad(data.yd[idx], (0, R_pad - batch_rows)).reshape(8, C)
+            wv = (
+                data.wd[idx]
+                if has_w
+                else jnp.ones((batch_rows,), jnp.float32)
+            )
+            wr = jnp.pad(wv, (0, R_pad - batch_rows)).reshape(8, C)
+            shard_w = jnp.sum(wr)
+        if rows_axis is not None:
+            den = jnp.maximum(lax.psum(shard_w, rows_axis), 1e-30)
+
+            def comb(x):
+                return lax.psum(x * shard_w, rows_axis) / den
+
+        else:
+
+            def comb(x):
+                return x
+
         def loss_fn(ints, vals):
-            return _loss_pallas(
-                ints, vals, data.Xr, data.yr, data.wr, opset, loss_elem,
-                N, P_TILE_LOSS, C_TILE, C, n_rows,
+            return comb(
+                _loss_pallas(
+                    ints, vals, Xr, yr, wr, opset, loss_elem,
+                    N, P_TILE_LOSS, C_TILE, C, R_eff,
+                )
             )
 
         def grad_fn(ints, vals, _n):
             vpad = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, Lv - N)))
-            return _loss_grad_pallas(
-                ints, vpad, data.Xr, data.yr, data.wr, opset, loss_elem,
-                N, P_TILE_LOSS, C_TILE, C, n_rows,
+            f, g = _loss_grad_pallas(
+                ints, vpad, Xr, yr, wr, opset, loss_elem,
+                N, P_TILE_LOSS, C_TILE, C, R_eff,
             )
+            return comb(f), comb(g)
 
         key, ii, pp, val0, mask_k, starts = _select_and_jitter(
             state, K, S, I, P, axis=axis
@@ -692,9 +1022,34 @@ def _make_const_opt_fn_pallas(
         best = jnp.argmin(fs, axis=1)
         vals = jnp.take_along_axis(xs, best[:, None, None], axis=1)[:, 0]
         fbest = jnp.take_along_axis(fs, best[:, None], axis=1)[:, 0]
+        if cfg.units_check:
+            # structure is fixed under const-opt: one penalty per tree,
+            # added to every compared loss (see the interp builder)
+            from ..ops.evolve import dim_penalty_batch
+            from ..ops.treeops import Tree as _Tree
+
+            pen_k = dim_penalty_batch(
+                _Tree(
+                    field(state.kind), field(state.op), field(state.lhs),
+                    field(state.rhs), field(state.feat), val0,
+                    field(state.length),
+                ),
+                cfg,
+            )
+            fbest = fbest + pen_k
+        n_ev = K * S * 2 * iters
+        base = None
+        if batch_rows is not None:
+            # batch-vs-batch accept: restart 0 starts at val0, so its f0 IS
+            # the member's loss on this batch; fractional eval accounting
+            # (reference eval_fraction, ConstantOptimization.jl:47)
+            base = f0[: K * S].reshape(K, S)[:, 0]
+            if cfg.units_check:
+                base = base + pen_k
+            n_ev = n_ev * cfg.eval_fraction
         return _accept_and_scatter(
             state, cfg, key, ii, pp, mask_k, val0, vals, fbest,
-            K * S * 2 * iters, axis=axis, norm=data.norm,
+            n_ev, axis=axis, norm=data.norm, base_loss=base,
         )
 
     return const_opt if axis is not None else jax.jit(const_opt)
@@ -712,8 +1067,10 @@ def _aot_cache_put(key, value):
         _AOT_CACHE[key] = value
 
 
-def _shard_const_opt(mesh, impl):
-    """Wrap an axis-mode const-opt impl in shard_map over the 'pop' axis."""
+def _shard_const_opt(mesh, impl, data_specs=None):
+    """Wrap an axis-mode const-opt impl in shard_map over the 'pop' axis.
+    ``data_specs``: rows-sharded ScoreData specs (score_data_specs) when the
+    mesh carries a 'rows' axis; default replicated."""
     import jax
 
     from ..ops.evolve import evo_state_specs
@@ -723,29 +1080,35 @@ def _shard_const_opt(mesh, impl):
     specs = evo_state_specs()
     return jax.jit(
         jax.shard_map(
-            impl, mesh=mesh, in_specs=(specs, P()), out_specs=specs,
+            impl, mesh=mesh,
+            in_specs=(specs, data_specs if data_specs is not None else P()),
+            out_specs=specs,
             check_vma=False,
         )
     )
 
 
 def _make_readback_fn(cfg: EvoConfig):
-    """Jitted packer: best-seen hall of fame + counters -> ONE f32 array."""
+    """Jitted packer: best-seen hall of fame + counters -> ONE array (f32,
+    or f64 for f64 engines — losses/constants must not round-trip through
+    f32)."""
     import jax
     import jax.numpy as jnp
+
+    vdt = jnp.dtype(cfg.val_dtype)
 
     @jax.jit
     def pack(state: EvoState):
         S1 = cfg.maxsize + 1
         parts = [
             state.bs_loss,
-            state.bs_exists.astype(jnp.float32),
-            state.bs_tree[6].astype(jnp.float32),  # lengths
+            state.bs_exists.astype(vdt),
+            state.bs_tree[6].astype(vdt),  # lengths
         ]
         for f in state.bs_tree[:6]:
-            parts.append(f.astype(jnp.float32).reshape(-1))
-        parts.append(state.num_evals[None])
-        parts.append(state.step.astype(jnp.float32)[None])
+            parts.append(f.astype(vdt).reshape(-1))
+        parts.append(state.num_evals[None].astype(vdt))
+        parts.append(state.step.astype(vdt)[None])
         return jnp.concatenate([p.reshape(-1) for p in parts])
 
     return pack
@@ -773,6 +1136,7 @@ def _decode_readback(buf: np.ndarray, cfg: EvoConfig):
 def _hof_pool_np(decoded_rows, cfg: EvoConfig):
     """Concatenate every process's decoded best-seen frontier into one
     migration pool (8-tuple, _topn_pool layout) as host numpy arrays."""
+    vdt = np.dtype(cfg.val_dtype)
     kinds, ops, lhss, rhss, feats, vals, lens, losses = ([] for _ in range(8))
     for bs_loss, bs_exists, bs_len, fields, _ in decoded_rows:
         kind, op, lhs, rhs, feat, val = fields
@@ -781,9 +1145,9 @@ def _hof_pool_np(decoded_rows, cfg: EvoConfig):
         lhss.append(lhs.astype(np.int32))
         rhss.append(rhs.astype(np.int32))
         feats.append(feat.astype(np.int32))
-        vals.append(val.astype(np.float32))
+        vals.append(val.astype(vdt))
         lens.append(np.where(bs_exists, bs_len, 0).astype(np.int32))
-        losses.append(np.where(bs_exists, bs_loss, np.inf).astype(np.float32))
+        losses.append(np.where(bs_exists, bs_loss, np.inf).astype(vdt))
     return (
         np.concatenate(kinds), np.concatenate(ops), np.concatenate(lhss),
         np.concatenate(rhss), np.concatenate(feats), np.concatenate(vals),
@@ -797,7 +1161,8 @@ def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
     kind, op, lhs, rhs, feat, val = fields
     flat = FlatTrees(
         kind.astype(np.int32), op.astype(np.int32), lhs.astype(np.int32),
-        rhs.astype(np.int32), feat.astype(np.int32), val.astype(np.float32),
+        rhs.astype(np.int32), feat.astype(np.int32),
+        val,  # engine dtype (f32 or f64) — no rounding on decode
         bs_len,
     )
     for s in range(len(bs_loss)):
@@ -842,9 +1207,19 @@ def _simplified_frontier_pool(members, options, cfg: EvoConfig, score_call, hof)
     cand = sorted(cand, key=lambda tc: tc[2])[:S1]
     cand = [(t, c) for t, c, _ in cand]
     trees = [t for t, _ in cand]
-    flat = flatten_trees(trees + [trees[0]] * (S1 - len(trees)), cfg.n_slots)
+    vdt = np.dtype(cfg.val_dtype)
+    flat = flatten_trees(
+        trees + [trees[0]] * (S1 - len(trees)), cfg.n_slots, dtype=vdt
+    )
     batch = Tree(*(jnp.asarray(a) for a in flat))
-    losses = np.asarray(score_call(batch)).astype(np.float32).copy()
+    losses = np.asarray(score_call(batch)).astype(vdt).copy()
+    if cfg.units_check:
+        # simplify can only merge/fold nodes, but keep the penalty exact:
+        # re-check each simplified tree with the SAME in-jit check the
+        # engine uses (one penalty semantics per search)
+        from ..ops.evolve import dim_penalty_batch_jit
+
+        losses += np.asarray(dim_penalty_batch_jit(batch, cfg)).astype(vdt)
     losses[len(trees):] = np.inf  # pad rows are never drawn
     for (t, c), loss in zip(cand, losses):
         if np.isfinite(loss):
@@ -883,7 +1258,7 @@ def device_search_one_output(
     from ..search import SearchResult  # late import (module cycle)
     from ..utils.export_csv import save_hall_of_fame
 
-    reason = device_mode_supported(options, dataset)
+    reason = device_mode_supported(options)
     if reason is not None:
         raise ValueError(
             f"scheduler='device' cannot honor this configuration ({reason}); "
@@ -918,9 +1293,14 @@ def device_search_one_output(
         # decorrelate this process's initial populations and engine RNG
         rng = np.random.default_rng([int(rng.integers(0, 2**31 - 1)), proc_id])
     N = options.max_nodes
-    X = dataset.X.astype(np.float32)
-    y = dataset.y.astype(np.float32)
-    w = None if dataset.weights is None else dataset.weights.astype(np.float32)
+    eng_dt = np.dtype(options.dtype)  # f32 or f64 (device_mode_supported)
+    if eng_dt == np.float64:
+        from ..utils.precision import ensure_x64_for_dtype
+
+        ensure_x64_for_dtype(eng_dt)
+    X = dataset.X.astype(eng_dt)
+    y = dataset.y.astype(eng_dt)
+    w = None if dataset.weights is None else dataset.weights.astype(eng_dt)
 
     # --- baseline loss ON DEVICE (no readback; becomes a program constant) --
     # Reference: update_baseline_loss!, /root/reference/src/LossFunctions.jl:201-215.
@@ -945,6 +1325,7 @@ def device_search_one_output(
         niterations=niterations,
         n_islands=I,
         n_rows=dataset.n,
+        dataset=dataset,
     )
     if cfg.warmup_maxsize_by == 0:
         # niterations only feeds the on-device warmup-maxsize schedule; with
@@ -958,13 +1339,17 @@ def device_search_one_output(
         # migration (/root/reference/src/Migration.jl:16-38)
         cfg = dataclasses.replace(cfg, migration=False, hof_migration=False)
 
-    # --- multi-device: shard the island axis over a 'pop' mesh --------------
-    # Each device owns I/n_dev islands; per-cycle cross-device traffic is the
-    # frequency-delta psum + best-seen merge (ops/evolve.py). Within-device
-    # migration uses the local topn pool; cross-device mixing rides the
-    # globally-merged best-seen frontier (hof_migration).
+    # --- multi-device: shard islands over 'pop' and (opt-in via
+    # data_sharding="rows") dataset rows over 'rows' -------------------------
+    # Each device owns I/pop_shards islands x R/rows_shards rows; per-cycle
+    # cross-device traffic is the frequency-delta psum + best-seen merge
+    # (pop axis) and the scalar-pair weighted-loss psum (rows axis) — see
+    # ops/evolve.py and _build_score_fn. Within-device migration uses the
+    # local topn pool; cross-device mixing rides the globally-merged
+    # best-seen frontier (hof_migration).
     n_dev = jax.local_device_count()
     mesh = None
+    rows_shards, pop_shards = 1, 1
     # ENGINE config: identical to cfg except the baseline constants are
     # canonicalized — the score normalization travels as the traced
     # ScoreData.norm, so every compiled engine/const-opt/migrate program is
@@ -972,13 +1357,42 @@ def device_search_one_output(
     # same shape. cfg (real baseline) stays for host-side score decoding.
     ecfg = dataclasses.replace(cfg, baseline_loss=1.0, use_baseline=True)
     cfg_local = ecfg
-    if n_dev > 1 and I % n_dev == 0:
+    if n_dev > 1:
+        if options.data_sharding == "rows":
+            # rows-first split (SURVEY §5.7: big-n configs want the row axis):
+            # the largest rows axis dividing the row count whose leftover pop
+            # axis divides the island count
+            for r in sorted(
+                (d for d in range(1, n_dev + 1) if n_dev % d == 0),
+                reverse=True,
+            ):
+                if dataset.n % r == 0 and I % (n_dev // r) == 0:
+                    rows_shards, pop_shards = r, n_dev // r
+                    break
+        elif I % n_dev == 0:
+            pop_shards = n_dev
+    if pop_shards * rows_shards > 1:
         from ..parallel.mesh import make_mesh
 
-        mesh = make_mesh(n_dev, 1, jax.local_devices())
-        cfg_local = dataclasses.replace(ecfg, n_islands=I // n_dev)
+        mesh = make_mesh(pop_shards, rows_shards, jax.local_devices())
+        cfg_local = dataclasses.replace(ecfg, n_islands=I // pop_shards)
+    rows_axis = "rows" if rows_shards > 1 else None
+    if rows_axis and cfg.batching and cfg.eval_fraction < 1.0:
+        # each rows shard draws batch_size/rows_shards local rows per cycle;
+        # account the effective global fresh-subset size exactly
+        eff = (
+            max(1, min(int(options.batch_size), dataset.n) // rows_shards)
+            * rows_shards
+        )
+        frac = min(eff, dataset.n) / dataset.n
+        cfg = dataclasses.replace(cfg, eval_fraction=frac)
+        ecfg = dataclasses.replace(ecfg, eval_fraction=frac)
+        cfg_local = dataclasses.replace(cfg_local, eval_fraction=frac)
 
-    use_pallas = jax.devices()[0].platform != "cpu"
+    # the Pallas kernels are f32-only; f64 engines score through the scan
+    # interpreter (XLA emulates f64 on TPU — correctness over speed, like
+    # the reference's Float64 default path)
+    use_pallas = jax.devices()[0].platform != "cpu" and eng_dt == np.float32
     if use_pallas:
         from ..ops.interp_pallas import pallas_supported
 
@@ -1007,23 +1421,48 @@ def device_search_one_output(
     )
     score_fn, score_data = _make_score_fn(
         X, y, w, options, use_pallas, ds_key=ds_key, norm=norm_val,
-        need_raw=need_raw,
+        need_raw=need_raw, rows_axis=rows_axis, rows_shards=rows_shards,
+        mesh=mesh,
     )
+    data_specs = score_data_specs(score_data) if rows_axis else None
+    bs_local = None
+    if cfg.batching:
+        bs_local = max(1, min(int(options.batch_size), dataset.n) // rows_shards)
     const_opt_fn = None
     if options.should_optimize_constants:
         has_w = w is not None
+        n_rows_local = dataset.n // rows_shards
         if use_pallas_grad:
             make_copt = lambda c, axis=None: _make_const_opt_fn_pallas(  # noqa: E731
-                options, c, dataset.n, has_w, axis=axis
+                options, c, n_rows_local, has_w, axis=axis,
+                rows_axis=rows_axis, batch_rows=bs_local,
             )
         else:
             make_copt = lambda c, axis=None: _make_const_opt_fn(  # noqa: E731
-                options, c, has_w, axis=axis
+                options, c, has_w, axis=axis, rows_axis=rows_axis,
+                batch_rows=bs_local,
             )
         if mesh is not None:
-            const_opt_fn = _shard_const_opt(mesh, make_copt(cfg_local, axis="pop"))
+            const_opt_fn = _shard_const_opt(
+                mesh, make_copt(cfg_local, axis="pop"), data_specs
+            )
         else:
             const_opt_fn = make_copt(ecfg)
+    finalize_fn = None
+    if cfg.batching:
+        # full-data finalize as its own program, ordered AFTER the batch
+        # const-opt (reference sequence: optimize on batch -> finalize ->
+        # migrate, /root/reference/src/SingleIteration.jl:107-132)
+        if mesh is not None:
+            from ..ops.evolve import make_sharded_finalize
+
+            finalize_fn = make_sharded_finalize(
+                mesh, cfg_local, score_fn, data_specs=data_specs
+            )
+        else:
+            from ..ops.evolve import run_finalize
+
+            finalize_fn = lambda st, d: run_finalize(st, d, ecfg, score_fn)  # noqa: E731
     readback_fn = _make_readback_fn(ecfg)
 
     # --- initial populations (host trees -> device state) -------------------
@@ -1039,7 +1478,7 @@ def device_search_one_output(
             )
     else:
         init_trees = Population.random_trees(I * P, options, dataset.n_features, rng)
-    flat = flatten_trees(init_trees, N)
+    flat = flatten_trees(init_trees, N, dtype=eng_dt)
 
     # score initial members on device (stay async: losses remain on device)
     batch0 = Tree(
@@ -1047,8 +1486,33 @@ def device_search_one_output(
         jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
         jnp.asarray(flat.length),
     )
-    score_call = lambda batch: score_fn.jitted(batch, score_data)  # noqa: E731
+    if rows_axis:
+        # host-triggered scoring (init, warm-start rescore, simplify pool)
+        # reuses the sharded dataset through a replicated-batch shard_map:
+        # every shard scores the whole batch on its row block and the psum
+        # inside score_fn yields replicated exact losses
+        from jax.sharding import PartitionSpec as _PS
+
+        _sc_sharded = jax.jit(
+            jax.shard_map(
+                lambda b, d: score_fn(b, d),
+                mesh=mesh,
+                in_specs=(_PS(), data_specs),
+                out_specs=_PS(),
+                check_vma=False,
+            )
+        )
+        score_call = lambda batch: _sc_sharded(batch, score_data)  # noqa: E731
+    else:
+        score_call = lambda batch: score_fn.jitted(batch, score_data)  # noqa: E731
     init_losses = score_call(batch0)
+    if cfg.units_check:
+        # the SAME in-jit structure-only check the engine applies — host
+        # legs must not mix a second (value-latching) penalty semantics
+        # into one search (decoded ENGINE losses already carry the penalty)
+        from ..ops.evolve import dim_penalty_batch_jit
+
+        init_losses = init_losses + dim_penalty_batch_jit(batch0, ecfg)
 
     seed = int(rng.integers(0, 2**31 - 1))
     state = init_state(flat, np.zeros(I * P), ecfg, seed)
@@ -1064,7 +1528,9 @@ def device_search_one_output(
         from ..ops.evolve import make_sharded_iteration, shard_evo_state
 
         state = shard_evo_state(state, mesh)
-        iter_fn = make_sharded_iteration(mesh, cfg_local, score_fn)
+        iter_fn = make_sharded_iteration(
+            mesh, cfg_local, score_fn, data_specs=data_specs
+        )
     else:
         iter_fn = None
 
@@ -1086,7 +1552,7 @@ def device_search_one_output(
             # one extra compile at most, per the shared batch_bucket policy
             strees = [m.tree for m in saved_members]
             pad = batch_bucket(len(strees)) - len(strees)
-            sflat = flatten_trees(strees + [strees[0]] * pad, N)
+            sflat = flatten_trees(strees + [strees[0]] * pad, N, dtype=eng_dt)
             sbatch = Tree(
                 jnp.asarray(sflat.kind), jnp.asarray(sflat.op),
                 jnp.asarray(sflat.lhs), jnp.asarray(sflat.rhs),
@@ -1094,10 +1560,16 @@ def device_search_one_output(
                 jnp.asarray(sflat.length),
             )
             slosses = np.asarray(score_call(sbatch))[: len(strees)]
+            if cfg.units_check:
+                from ..ops.evolve import dim_penalty_batch_jit
+
+                slosses = slosses + np.asarray(
+                    dim_penalty_batch_jit(sbatch, ecfg)
+                )[: len(strees)]
             for m, loss in zip(saved_members, slosses):
                 comp = m.get_complexity(options)
                 m.loss = float(loss)
-                m.score = float(_score_of(float(loss), float(comp), cfg))
+                m.score = float(_score_of(m.loss, float(comp), cfg))
                 hof.update(m, options)
     early_stop = options.early_stop_fn()
 
@@ -1113,7 +1585,10 @@ def device_search_one_output(
         # identical shapes/config. Keys hold the score_fn / opset / loss
         # OBJECTS (never id()): the cache entry pins them, so a recycled
         # address can never alias an executable with stale baked-in data.
-        k_iter = ("iter", cfg_local, score_fn, n_dev if mesh else 0)
+        k_iter = (
+            "iter", cfg_local, score_fn,
+            (pop_shards, rows_shards) if mesh else 0,
+        )
         run_step = _AOT_CACHE.get(k_iter)
         if run_step is None:
             run_step = (
@@ -1131,12 +1606,30 @@ def device_search_one_output(
                 options.operators, options.loss,
                 options.optimizer_probability,
                 options.optimizer_nrestarts, options.optimizer_iterations,
-                options.optimizer_algorithm, n_dev if mesh else 0,
+                options.optimizer_algorithm,
+                (pop_shards, rows_shards) if mesh else 0,
             )
             copt_step = _AOT_CACHE.get(k_copt)
             if copt_step is None:
                 copt_step = const_opt_fn.lower(state, score_data).compile()
                 _aot_cache_put(k_copt, copt_step)
+        fin_step = None
+        if finalize_fn is not None:
+            k_fin = (
+                "fin", cfg_local, score_fn,
+                (pop_shards, rows_shards) if mesh else 0,
+            )
+            fin_step = _AOT_CACHE.get(k_fin)
+            if fin_step is None:
+                if mesh is not None:
+                    fin_step = finalize_fn.lower(state, score_data).compile()
+                else:
+                    from ..ops.evolve import run_finalize
+
+                    fin_step = run_finalize.lower(
+                        state, score_data, ecfg, score_fn
+                    ).compile()
+                _aot_cache_put(k_fin, fin_step)
         k_rb = ("rb", ecfg)
         readback_step = _AOT_CACHE.get(k_rb)
         if readback_step is None:
@@ -1153,9 +1646,9 @@ def device_search_one_output(
             zi = jnp.zeros((S1, N), jnp.int32)
             dummy_pool = (
                 zi.at[:, 0].set(1), zi, zi, zi, zi,
-                jnp.zeros((S1, N), jnp.float32),
+                jnp.zeros((S1, N), jnp.dtype(ecfg.val_dtype)),
                 jnp.ones((S1,), jnp.int32),
-                jnp.full((S1,), jnp.inf, jnp.float32),  # invalid -> no-op
+                jnp.full((S1,), jnp.inf, jnp.dtype(ecfg.val_dtype)),  # invalid -> no-op
             )
             _mfp(
                 state, ecfg, dummy_pool, float(options.fraction_replaced_hof),
@@ -1171,6 +1664,7 @@ def device_search_one_output(
             else lambda st, d: run_iteration(st, d, ecfg, score_fn)
         )
         copt_step = const_opt_fn
+        fin_step = finalize_fn
         readback_step = readback_fn
 
     from ..utils.stdin_reader import StdinReader
@@ -1196,6 +1690,10 @@ def device_search_one_output(
         state = run_step(state, score_data)
         if copt_step is not None:
             state = copt_step(state, score_data)
+        if fin_step is not None:
+            # batching: full-data finalize AFTER the batch const-opt, so the
+            # readback below only ever sees exact losses
+            state = fin_step(state, score_data)
         buf = np.asarray(readback_step(state))  # the iteration's ONE readback
 
         if multi_host:
@@ -1367,9 +1865,10 @@ def device_search_one_output(
         # a best-per-complexity snapshot of the final populations and let
         # every process merge the same global set.
         S1 = cfg.maxsize + 1
-        fl = np.full((S1,), np.inf, np.float32)
-        fn_ = np.zeros((S1,), np.float32)
-        ffields = [np.zeros((S1, N), np.float32) for _ in range(6)]
+        vdt_np = np.dtype(cfg.val_dtype)
+        fl = np.full((S1,), np.inf, vdt_np)
+        fn_ = np.zeros((S1,), vdt_np)
+        ffields = [np.zeros((S1, N), vdt_np) for _ in range(6)]
         for i, p in final_slots:
             s = min(int(length[i, p]), cfg.maxsize)
             if np.isfinite(loss[i, p]) and loss[i, p] < fl[s]:

@@ -39,14 +39,27 @@ def _tree_loss_fn(opset: OperatorSet, loss_elem: Callable):
     return loss
 
 
-def _bfgs_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
+def _bfgs_single(
+    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None
+):
     """Fixed-iteration BFGS with Armijo backtracking on one tree's constants.
-    mask[N]: which slots are free parameters. Returns (val, f)."""
+    mask[N]: which slots are free parameters. Returns (val, f).
+
+    ``combine``: rows-sharded mode (shard_map) — ``loss_fn`` then sees only
+    this shard's row block and ``combine`` merges per-shard values into the
+    global weighted mean (psum(x*wsum)/psum(wsum)). The SAME linear map
+    applies to losses and to every gradient component, so one callable
+    covers both; it must be applied OUTSIDE jax.grad (autodiff through a
+    forward psum yields only the local gradient piece, which would diverge
+    the rows-replicated state)."""
     N = val0.shape[0]
     dtype = val0.dtype
     eye = jnp.eye(N, dtype=dtype)
+    if combine is None:
+        combine = lambda x: x  # noqa: E731
 
     f0, g0 = jax.value_and_grad(loss_fn)(val0, structure, X, y, w, has_w)
+    f0, g0 = combine(f0), combine(g0)
     g0 = jnp.where(mask, g0, 0.0)
 
     def body(carry, _):
@@ -68,16 +81,16 @@ def _bfgs_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
         def ls_body(state):
             alpha, _, k = state
             alpha = alpha * 0.5
-            f_try = loss_fn(x + alpha * d, structure, X, y, w, has_w)
+            f_try = combine(loss_fn(x + alpha * d, structure, X, y, w, has_w))
             return alpha, f_try, k + 1
 
-        f_try = loss_fn(x + d, structure, X, y, w, has_w)
+        f_try = combine(loss_fn(x + d, structure, X, y, w, has_w))
         alpha, f_new, _ = lax.while_loop(ls_cond, ls_body, (jnp.asarray(1.0, dtype), f_try, 0))
 
         ok = jnp.isfinite(f_new) & (f_new < f)
         x_new = jnp.where(ok, x + alpha * d, x)
         f_next = jnp.where(ok, f_new, f)
-        g_new = jax.grad(loss_fn)(x_new, structure, X, y, w, has_w)
+        g_new = combine(jax.grad(loss_fn)(x_new, structure, X, y, w, has_w))
         g_new = jnp.where(mask, g_new, 0.0)
 
         s = x_new - x
@@ -94,19 +107,28 @@ def _bfgs_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
     return x, f
 
 
-def _newton_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
+def _newton_single(
+    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None
+):
     """Newton + backtracking on a SINGLE masked constant (the reference's
     1-constant special case, /root/reference/src/ConstantOptimization.jl:22-41).
-    Curvature via a Hessian-vector product along the masked direction."""
+    Curvature via a Hessian-vector product along the masked direction.
+    ``combine``: see _bfgs_single — applied outside grad/jvp (both are
+    linear maps of the per-shard pieces)."""
     e = mask.astype(val0.dtype)
+    if combine is None:
+        combine = lambda x: x  # noqa: E731
 
     def f(v):
         return loss_fn(v, structure, X, y, w, has_w)
 
+    def fc(v):
+        return combine(f(v))
+
     def body(carry, _):
         x, fx = carry
-        g = jnp.vdot(jax.grad(f)(x), e)
-        h = jnp.vdot(jax.jvp(jax.grad(f), (x,), (e,))[1], e)
+        g = jnp.vdot(combine(jax.grad(f)(x)), e)
+        h = jnp.vdot(combine(jax.jvp(jax.grad(f), (x,), (e,))[1]), e)
         step = jnp.where(jnp.abs(h) > 1e-30, -g / h, -g)
         step = jnp.where(jnp.isfinite(step), step, 0.0)
 
@@ -117,9 +139,9 @@ def _newton_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
         def ls_body(state):
             alpha, _, k = state
             alpha = alpha * 0.5
-            return alpha, f(x + alpha * step * e), k + 1
+            return alpha, fc(x + alpha * step * e), k + 1
 
-        f_try = f(x + step * e)
+        f_try = fc(x + step * e)
         alpha, f_new, _ = lax.while_loop(
             ls_cond, ls_body, (jnp.asarray(1.0, val0.dtype), f_try, 0)
         )
@@ -127,20 +149,25 @@ def _newton_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
         x_new = jnp.where(ok, x + alpha * step * e, x)
         return (x_new, jnp.where(ok, f_new, fx)), None
 
-    f0 = f(val0)
+    f0 = fc(val0)
     (x, fx), _ = lax.scan(body, (val0, f0), None, length=iters)
     return x, fx
 
 
-def _neldermead_single(loss_fn, val0, structure, X, y, w, has_w, mask, iters: int):
+def _neldermead_single(
+    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None
+):
     """Masked Nelder–Mead simplex (the reference's configurable alternative,
-    /root/reference/src/Options.jl:522-532). Non-constant slots stay pinned."""
+    /root/reference/src/Options.jl:522-532). Non-constant slots stay pinned.
+    ``combine``: see _bfgs_single (derivative-free, so values only)."""
     N = val0.shape[0]
     dtype = val0.dtype
     mf = mask.astype(dtype)
+    if combine is None:
+        combine = lambda x: x  # noqa: E731
 
     def f(v):
-        return loss_fn(v, structure, X, y, w, has_w)
+        return combine(loss_fn(v, structure, X, y, w, has_w))
 
     # initial simplex: val0 plus one perturbed vertex per (masked) coordinate
     steps = jnp.where(val0 != 0, 0.05 * val0, 0.00025) * mf

@@ -78,9 +78,11 @@ __all__ = [
     "EvoState",
     "init_state",
     "run_iteration",
+    "run_finalize",
     "evo_state_specs",
     "shard_evo_state",
     "make_sharded_iteration",
+    "make_sharded_finalize",
     "extract_topn_pool",
     "migrate_from_pool",
     "merge_best_seen",
@@ -154,6 +156,33 @@ class EvoConfig:
     # member on full data at the iteration boundary.
     batching: bool = False
     eval_fraction: float = 1.0
+    # compute dtype for constants/losses/scores ("float32" | "float64"); the
+    # reference DEFAULTS to Float64 (/root/reference/src/SymbolicRegression.jl:360-447),
+    # so the engine must honor it. f64 engines require jax_enable_x64 and use
+    # the scan-interpreter scorer (the Pallas kernels are f32-only); tree
+    # surgery keeps its int fields on the MXU one-hot path and gathers only
+    # the f64 constants per-lane (treeops.gather_slots).
+    val_dtype: str = "float32"
+    # in-jit dimensional analysis (reference WildcardQuantity abstract eval,
+    # /root/reference/src/DimensionalAnalysis.jl:45-226): one postorder pass
+    # propagates (SI-exponent vector[7], wildcard, violation) per slot, and
+    # violating candidates take the additive loss penalty (dimensional
+    # regularization, /root/reference/src/LossFunctions.jl:217-227).
+    # Documented deviation: the engine check is structure-only — the host
+    # checker also latches violations on non-finite SAMPLE values, which the
+    # engine leaves to ordinary inf-loss scoring. Tables built by
+    # build_evo_config from operator NAMES: una_dim_pow[i] = exponent
+    # multiplier for power-like unary ops (sqrt 0.5, square 2, inv -1,
+    # abs/neg 1, ...) or None (generic: input must be dimensionless or
+    # wildcard); bin_dim_code[i] in {0: add/sub, 1: mult, 2: div,
+    # 3: generic/pow}.
+    units_check: bool = False
+    x_dims: tuple = ()  # F rows of 7 SI exponents (floats)
+    y_dims: tuple | None = None
+    una_dim_pow: tuple = ()
+    bin_dim_code: tuple = ()
+    dim_penalty: float = 1000.0
+    allow_wildcards: bool = True
 
 
 class EvoState(NamedTuple):
@@ -215,6 +244,7 @@ def init_state(
     flat_arrays: FlatTrees-style tuple with shapes [I*P, N] / [I*P]
     losses: [I*P] float64/32 host losses (already scored)."""
     I, P, N, S = cfg.n_islands, cfg.pop_size, cfg.n_slots, cfg.maxsize
+    vdt = jnp.dtype(cfg.val_dtype)
 
     def r(a, dtype):
         return jnp.asarray(np.asarray(a), dtype).reshape(I, P, *np.shape(a)[1:])
@@ -224,10 +254,10 @@ def init_state(
     lhs = r(flat_arrays.lhs, jnp.int32)
     rhs = r(flat_arrays.rhs, jnp.int32)
     feat = r(flat_arrays.feat, jnp.int32)
-    val = r(flat_arrays.val, jnp.float32)
+    val = r(flat_arrays.val, vdt)
     length = jnp.asarray(np.asarray(flat_arrays.length), jnp.int32).reshape(I, P)
-    loss = jnp.asarray(np.asarray(losses), jnp.float32).reshape(I, P)
-    comp = length.astype(jnp.float32)
+    loss = jnp.asarray(np.asarray(losses), vdt).reshape(I, P)
+    comp = length.astype(vdt)
     score = _score_of(loss, comp, cfg)
     freq = (
         jnp.asarray(freq_init, jnp.float32)
@@ -240,7 +270,7 @@ def init_state(
         jnp.zeros((S + 1, N), jnp.int32),  # lhs
         jnp.zeros((S + 1, N), jnp.int32),  # rhs
         jnp.zeros((S + 1, N), jnp.int32),  # feat
-        jnp.zeros((S + 1, N), jnp.float32),  # val
+        jnp.zeros((S + 1, N), vdt),  # val
         jnp.zeros((S + 1,), jnp.int32),  # length
     )
     return EvoState(
@@ -255,7 +285,7 @@ def init_state(
         score,
         birth=jnp.tile(jnp.arange(P, dtype=jnp.int32)[None], (I, 1)),
         freq=freq,
-        bs_loss=jnp.full((S + 1,), jnp.inf, jnp.float32),
+        bs_loss=jnp.full((S + 1,), jnp.inf, vdt),
         bs_tree=bs_tree,
         bs_exists=jnp.zeros((S + 1,), bool),
         key=jax.random.PRNGKey(seed),
@@ -391,6 +421,7 @@ def _swap_operands(key, tree: Tree, cfg: EvoConfig, sizes) -> Tree:
 
 def _leaf_material(key, cfg: EvoConfig, n_slots: int) -> Tree:
     """One random leaf (50/50 const/feature) as a 1-node block."""
+    vdt = jnp.dtype(cfg.val_dtype)
     k1, k2, k3 = jax.random.split(key, 3)
     is_const = jax.random.uniform(k1, (), dtype=jnp.float32) < 0.5
     if cfg.nfeatures <= 0:
@@ -399,7 +430,7 @@ def _leaf_material(key, cfg: EvoConfig, n_slots: int) -> Tree:
     z = jnp.zeros((N,), jnp.int32)
     kind = z.at[0].set(jnp.where(is_const, KIND_CONST, KIND_VAR))
     feat = z.at[0].set(jax.random.randint(k2, (), 0, max(cfg.nfeatures, 1), dtype=jnp.int32))
-    val = jnp.zeros((N,), jnp.float32).at[0].set(jax.random.normal(k3, (), dtype=jnp.float32))
+    val = jnp.zeros((N,), vdt).at[0].set(jax.random.normal(k3, (), dtype=vdt))
     return Tree(kind, z, z, z, feat, val, jnp.asarray(1, jnp.int32))
 
 
@@ -441,7 +472,7 @@ def _add_node(key, tree: Tree, cfg: EvoConfig) -> Tree:
     feat = jnp.zeros((N,), jnp.int32)
     feat = feat.at[0].set(l1.feat[0])
     feat = feat.at[1].set(jnp.where(use_bin, l2.feat[0], 0))
-    val = jnp.zeros((N,), jnp.float32)
+    val = jnp.zeros((N,), jnp.dtype(cfg.val_dtype))
     val = val.at[0].set(l1.val[0])
     val = val.at[1].set(jnp.where(use_bin, l2.val[0], 0.0))
     mat = Tree(kind, op, lhs, rhs, feat, val, m_len.astype(jnp.int32))
@@ -508,7 +539,10 @@ def _randomize(key, tree: Tree, cfg: EvoConfig, curmaxsize) -> Tree:
     size ~ U[1, curmaxsize] capped by slots."""
     k1, k2 = jax.random.split(key)
     m = jax.random.randint(k1, (), 1, jnp.maximum(curmaxsize, 1) + 1, dtype=jnp.int32)
-    return random_tree(k2, m, tree.n_slots, cfg.nfeatures, cfg.n_unary, cfg.n_binary)
+    return random_tree(
+        k2, m, tree.n_slots, cfg.nfeatures, cfg.n_unary, cfg.n_binary,
+        dtype=jnp.dtype(cfg.val_dtype),
+    )
 
 
 def _crossover(key, t1: Tree, t2: Tree, cfg: EvoConfig, s1, s2):
@@ -571,7 +605,7 @@ def _apply_mutation(
         return Tree(
             t.kind.astype(jnp.int32), t.op.astype(jnp.int32),
             t.lhs.astype(jnp.int32), t.rhs.astype(jnp.int32),
-            t.feat.astype(jnp.int32), t.val.astype(jnp.float32),
+            t.feat.astype(jnp.int32), t.val.astype(jnp.dtype(cfg.val_dtype)),
             t.length.astype(jnp.int32),
         )
 
@@ -663,6 +697,136 @@ def _constraints_ok(tree: Tree, cfg: EvoConfig) -> jax.Array:
                 )
                 ok &= ~jnp.any(is_outer & (child_nest > maxn))
     return ok
+
+
+_DIM_TOL = 1e-4  # SI-exponent equality tolerance (1/3 etc. live in f32)
+
+
+def _dim_violates(tree: Tree, cfg: EvoConfig) -> jax.Array:
+    """In-jit WildcardQuantity abstract evaluation for ONE tree: True iff
+    the tree is dimensionally inconsistent with cfg.x_dims/y_dims
+    (reference: violates_dimensional_constraints,
+    /root/reference/src/DimensionalAnalysis.jl:45-226; see the EvoConfig
+    units_check docstring for the structure-only deviation). Static no-op
+    (False) when units are not configured."""
+    if not cfg.units_check:
+        return jnp.asarray(False)
+    N = tree.n_slots
+    F = max(len(cfg.x_dims), 1)
+    xd = jnp.asarray(
+        cfg.x_dims if cfg.x_dims else ((0.0,) * 7,), jnp.float32
+    )  # [F, 7]
+    nu = max(cfg.n_unary, 1)
+    nb = max(cfg.n_binary, 1)
+    u_pow = jnp.asarray(
+        [p if p is not None else 0.0 for p in cfg.una_dim_pow] or [0.0],
+        jnp.float32,
+    )
+    u_is_pow = jnp.asarray(
+        [p is not None for p in cfg.una_dim_pow] or [False], bool
+    )
+    b_code = jnp.asarray(list(cfg.bin_dim_code) or [3], jnp.int32)
+
+    def dimless(d):  # d: [7]
+        return jnp.all(jnp.abs(d) < _DIM_TOL)
+
+    def body(i, carry):
+        dims, wc, vio = carry  # [N,7], [N], [N]
+        k = tree.kind[i]
+        o = tree.op[i]
+        li, ri = tree.lhs[i], tree.rhs[i]
+        ld, lw, lv = dims[li], wc[li], vio[li]
+        rd, rw, rv = dims[ri], wc[ri], vio[ri]
+
+        # leaves: constants are wildcards (unless forbidden), variables
+        # carry their feature's dims and are NEVER wildcards
+        leaf_dims = jnp.where(
+            k == KIND_VAR, xd[jnp.clip(tree.feat[i], 0, F - 1)], 0.0
+        )
+        leaf_wc = (k == KIND_CONST) & cfg.allow_wildcards
+
+        # unary
+        up = u_pow[jnp.clip(o, 0, nu - 1)]
+        u_ispow = u_is_pow[jnp.clip(o, 0, nu - 1)]
+        u_dims = jnp.where(u_ispow, ld * up, jnp.zeros((7,), jnp.float32))
+        u_wc = u_ispow & lw
+        u_vio = lv | (~u_ispow & ~(dimless(ld) | lw))
+
+        # binary
+        code = b_code[jnp.clip(o, 0, nb - 1)]
+        same = jnp.all(jnp.abs(ld - rd) < _DIM_TOL)
+        as_dims = jnp.where(
+            same,
+            ld,
+            jnp.where(
+                lw & rw,
+                jnp.zeros((7,), jnp.float32),
+                jnp.where(lw, rd, ld),
+            ),
+        )
+        as_wc = lw & rw
+        as_vio = ~same & ~lw & ~rw
+        mul_dims = jnp.where(code == 1, ld + rd, ld - rd)
+        mul_wc = lw | rw
+        gen_ok = (dimless(ld) | lw) & (dimless(rd) | rw)
+        b_dims = jnp.where(
+            code == 0,
+            as_dims,
+            jnp.where(code <= 2, mul_dims, jnp.zeros((7,), jnp.float32)),
+        )
+        b_wc = jnp.where(code == 0, as_wc, (code <= 2) & mul_wc)
+        b_vio = lv | rv | jnp.where(
+            code == 0, as_vio, jnp.where(code <= 2, False, ~gen_ok)
+        )
+
+        new_dims = jnp.where(
+            k == KIND_UNARY, u_dims, jnp.where(k == KIND_BINARY, b_dims, leaf_dims)
+        )
+        new_wc = jnp.where(
+            k == KIND_UNARY, u_wc, jnp.where(k == KIND_BINARY, b_wc, leaf_wc)
+        )
+        new_vio = jnp.where(
+            k == KIND_UNARY, u_vio, jnp.where(k == KIND_BINARY, b_vio, False)
+        )
+        return (
+            dims.at[i].set(new_dims),
+            wc.at[i].set(new_wc),
+            vio.at[i].set(new_vio),
+        )
+
+    dims, wc, vio = lax.fori_loop(
+        0,
+        N,
+        body,
+        (
+            jnp.zeros((N, 7), jnp.float32),
+            jnp.zeros((N,), bool),
+            jnp.zeros((N,), bool),
+        ),
+    )
+    root = jnp.clip(tree.length - 1, 0, N - 1)
+    out = vio[root]
+    if cfg.y_dims is not None:
+        yd = jnp.asarray(cfg.y_dims, jnp.float32)
+        out |= ~wc[root] & ~jnp.all(jnp.abs(dims[root] - yd) < _DIM_TOL)
+    return out
+
+
+def dim_penalty_batch(batch: Tree, cfg: EvoConfig):
+    """Additive dimensional-regularization penalties for a tree batch [B]
+    (0.0 everywhere when units are off — a static no-op under jit)."""
+    if not cfg.units_check:
+        return jnp.zeros((batch.kind.shape[0],), jnp.dtype(cfg.val_dtype))
+    viol = jax.vmap(lambda t: _dim_violates(t, cfg))(batch)
+    return jnp.where(viol, cfg.dim_penalty, 0.0).astype(jnp.dtype(cfg.val_dtype))
+
+
+#: jitted twin for the HOST-scored legs (init populations, warm-start
+#: rescore, simplify pool): the SAME structure-only check the engine applies
+#: in-graph, so one search never mixes two penalty semantics on one tree
+dim_penalty_batch_jit = functools.partial(jax.jit, static_argnames=("cfg",))(
+    dim_penalty_batch
+)
 
 
 def merge_best_seen(
@@ -842,7 +1006,7 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
         lhs=jnp.zeros((L, N), jnp.int32),
         rhs=jnp.zeros((L, N), jnp.int32),
         feat=jnp.zeros((L, N), jnp.int32),
-        val=jnp.zeros((L, N), jnp.float32),
+        val=jnp.zeros((L, N), jnp.dtype(cfg.val_dtype)),
         length=jnp.ones((L,), jnp.int32),
     )
     cand2 = pick(xo2, leaf_stub, do_xover)
@@ -872,6 +1036,10 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
         losses = score_fn(batch, data, k_bat)  # [2L]
     else:
         losses = score_fn(batch, data)  # [2L]
+    # dimensional regularization (static no-op without units): violating
+    # candidates carry the additive penalty into accept, replacement, and
+    # the frontier merge, like the reference's eval_loss
+    losses = losses + dim_penalty_batch(batch, cfg)
     loss1, loss2 = losses[:L], losses[L:]
     score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg, data.norm)
     score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg, data.norm)
@@ -1030,56 +1198,6 @@ def _run_iteration_impl(
     state = lax.fori_loop(0, total, body, state)
     state = state._replace(iteration=state.iteration + 1)
 
-    if cfg.batching:
-        # full-data finalize: every member's stored loss/score becomes exact
-        # before migration and constant optimization read them (reference:
-        # finalize_scores, /root/reference/src/Population.jl:162-176)
-        I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
-        all_members = Tree(
-            state.kind.reshape(I * P, N), state.op.reshape(I * P, N),
-            state.lhs.reshape(I * P, N), state.rhs.reshape(I * P, N),
-            state.feat.reshape(I * P, N), state.val.reshape(I * P, N),
-            state.length.reshape(I * P),
-        )
-        full_loss = score_fn(all_members, data).reshape(I, P)
-        inc = jnp.asarray(I * P, jnp.float32)
-        if axis is not None:
-            inc = lax.psum(inc, axis)  # per-shard I is local; count globally
-        state = state._replace(
-            loss=full_loss,
-            score=_score_of(
-                full_loss, state.length.astype(jnp.float32), cfg, data.norm
-            ),
-            num_evals=state.num_evals + inc,
-        )
-        # full-data-honest frontier: the in-cycle merges above saw minibatch
-        # losses, so a lucky-batch draw could permanently occupy a size slot
-        # and block genuinely better candidates (the reference picks
-        # best_seen only after finalize_scores,
-        # /root/reference/src/SingleIteration.jl:64-100 + Population.jl:162-176).
-        # Rescore the frontier trees on full data, then fold the finalized
-        # population back in so membership competes on exact losses.
-        bs_len = state.bs_tree[6]
-        bs_batch = Tree(*state.bs_tree[:6], bs_len)
-        bs_full = score_fn(bs_batch, data)
-        bs_valid = state.bs_exists & jnp.isfinite(bs_full) & (bs_len >= 1)
-        state = state._replace(
-            bs_loss=jnp.where(bs_valid, bs_full, jnp.inf),
-            bs_exists=bs_valid,
-            # bs is replicated across shards (rescore is duplicated work, not
-            # extra evals), so count its rows once, without a psum
-            num_evals=state.num_evals + jnp.asarray(bs_len.shape[0], jnp.float32),
-        )
-        state = merge_best_seen(
-            state, cfg,
-            full_loss.reshape(I * P),
-            jnp.isfinite(full_loss.reshape(I * P)) & (all_members.length >= 1),
-            [all_members.kind, all_members.op, all_members.lhs,
-             all_members.rhs, all_members.feat, all_members.val],
-            all_members.length,
-            axis=axis,
-        )
-
     # frequency-window decay (proportional-smoothing variant of move_window!,
     # /root/reference/src/AdaptiveParsimony.jl:57-89; window 100k)
     total_f = jnp.sum(state.freq)
@@ -1089,10 +1207,15 @@ def _run_iteration_impl(
     )
 
     # --- migration (reference: /root/reference/src/Migration.jl:16-38) ------
-    if cfg.migration:
-        state = _migrate(state, cfg, use_hof=False, norm=data.norm)
-    if cfg.hof_migration:
-        state = _migrate(state, cfg, use_hof=True, norm=data.norm)
+    # Under cfg.batching, migration moves to the FINALIZE program
+    # (_finalize_impl): the reference migrates on finalized full-data scores
+    # (main loop runs migrate! after optimize_and_simplify's
+    # finalize_scores), and the stored losses here are still batch-noisy.
+    if not cfg.batching:
+        if cfg.migration:
+            state = _migrate(state, cfg, use_hof=False, norm=data.norm)
+        if cfg.hof_migration:
+            state = _migrate(state, cfg, use_hof=True, norm=data.norm)
     if axis is not None:
         # re-replicate the key: every shard derives the next key from the
         # same iteration-entry key (shard streams diverged via fold_in above)
@@ -1100,9 +1223,101 @@ def _run_iteration_impl(
     return state
 
 
+def _finalize_impl(
+    state: EvoState, data, cfg: EvoConfig, score_fn, axis=None
+) -> EvoState:
+    """Full-data finalize under cfg.batching, as its OWN program so the
+    driver can order it AFTER batch constant optimization — the reference's
+    sequence (/root/reference/src/SingleIteration.jl:107-132: optimize on a
+    batch sample, then finalize_scores on full data, then the main loop
+    migrates):
+
+    1. every member's stored loss/score becomes exact
+       (finalize_scores, /root/reference/src/Population.jl:162-176);
+    2. the best-seen frontier is rescored on full data and the finalized
+       population folded back in, so membership competes on exact losses —
+       a lucky minibatch draw can neither occupy a size slot nor reach the
+       readback (the reference picks best_seen only after finalize,
+       /root/reference/src/SingleIteration.jl:64-100);
+    3. migration (skipped by run_iteration when batching) runs on the
+       now-exact scores."""
+    key_in = state.key
+    if axis is not None:
+        # same key discipline as _run_iteration_impl: shards diverge via an
+        # axis-index fold for their own migration draws, and the stored key
+        # re-replicates from the ENTRY key at the end
+        state = state._replace(key=jax.random.fold_in(key_in, lax.axis_index(axis)))
+    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    all_members = Tree(
+        state.kind.reshape(I * P, N), state.op.reshape(I * P, N),
+        state.lhs.reshape(I * P, N), state.rhs.reshape(I * P, N),
+        state.feat.reshape(I * P, N), state.val.reshape(I * P, N),
+        state.length.reshape(I * P),
+    )
+    full_loss = (
+        score_fn(all_members, data) + dim_penalty_batch(all_members, cfg)
+    ).reshape(I, P)
+    inc = jnp.asarray(I * P, jnp.float32)
+    if axis is not None:
+        inc = lax.psum(inc, axis)  # per-shard I is local; count globally
+    state = state._replace(
+        loss=full_loss,
+        score=_score_of(
+            full_loss, state.length.astype(jnp.float32), cfg, data.norm
+        ),
+        num_evals=state.num_evals + inc,
+    )
+    bs_len = state.bs_tree[6]
+    bs_batch = Tree(*state.bs_tree[:6], bs_len)
+    bs_full = score_fn(bs_batch, data) + dim_penalty_batch(bs_batch, cfg)
+    bs_valid = state.bs_exists & jnp.isfinite(bs_full) & (bs_len >= 1)
+    state = state._replace(
+        bs_loss=jnp.where(bs_valid, bs_full, jnp.inf),
+        bs_exists=bs_valid,
+        # bs is replicated across shards (rescore is duplicated work, not
+        # extra evals), so count its rows once, without a psum
+        num_evals=state.num_evals + jnp.asarray(bs_len.shape[0], jnp.float32),
+    )
+    state = merge_best_seen(
+        state, cfg,
+        full_loss.reshape(I * P),
+        jnp.isfinite(full_loss.reshape(I * P)) & (all_members.length >= 1),
+        [all_members.kind, all_members.op, all_members.lhs,
+         all_members.rhs, all_members.feat, all_members.val],
+        all_members.length,
+        axis=axis,
+    )
+    if cfg.migration:
+        state = _migrate(state, cfg, use_hof=False, norm=data.norm)
+    if cfg.hof_migration:
+        state = _migrate(state, cfg, use_hof=True, norm=data.norm)
+    if axis is not None:
+        state = state._replace(key=jax.random.fold_in(key_in, 0xF17A))
+    return state
+
+
 run_iteration = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
     _run_iteration_impl
 )
+
+run_finalize = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
+    _finalize_impl
+)
+
+
+def make_sharded_finalize(mesh, cfg_local: EvoConfig, score_fn, data_specs=None):
+    """shard_map twin of make_sharded_iteration for the finalize program."""
+    specs = evo_state_specs()
+    from jax.sharding import PartitionSpec as _P
+
+    fn = jax.shard_map(
+        lambda st, data: _finalize_impl(st, data, cfg_local, score_fn, axis="pop"),
+        mesh=mesh,
+        in_specs=(specs, data_specs if data_specs is not None else _P()),
+        out_specs=specs,
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 # ---------------------------------------------------------------------------
@@ -1148,12 +1363,20 @@ def shard_evo_state(state: EvoState, mesh) -> EvoState:
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
-def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn):
-    """Jitted run_iteration over a ('pop', ...) mesh via shard_map: each
+def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn, data_specs=None):
+    """Jitted run_iteration over a ('pop', 'rows') mesh via shard_map: each
     device advances its own island slice through the full iteration;
     frequency stats and the best-seen frontier stay globally lockstep via
     in-program collectives. ``cfg_local.n_islands`` is the PER-SHARD island
-    count (global islands / pop-axis size)."""
+    count (global islands / pop-axis size).
+
+    ``data_specs``: per-leaf PartitionSpecs for the ScoreData argument —
+    pass device_search.score_data_specs(data) when the dataset rows are
+    sharded over the mesh's 'rows' axis (score_fn must then psum over
+    'rows', which _build_score_fn(rows_axis="rows") emits; the EvoState
+    stays replicated along 'rows' because every rows-shard sees identical
+    psum-combined losses and a replicated PRNG key). Default: data
+    replicated (pytree-prefix spec)."""
     specs = evo_state_specs()
     from jax.sharding import PartitionSpec as _P
 
@@ -1162,7 +1385,7 @@ def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn):
             st, data, cfg_local, score_fn, axis="pop"
         ),
         mesh=mesh,
-        in_specs=(specs, _P()),  # data replicated (pytree-prefix spec)
+        in_specs=(specs, data_specs if data_specs is not None else _P()),
         out_specs=specs,
         # replicated outputs are replicated by construction (psum/fold_in of
         # replicated inputs); VMA inference can't see that through the scan

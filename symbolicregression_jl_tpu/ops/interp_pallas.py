@@ -456,10 +456,15 @@ def pack_flat_fused(flat: FlatTrees, opset: OperatorSet):
     return jnp.asarray(ints), jnp.asarray(vals)
 
 
-def _reshape_rows(X, y, weights):
-    """Pad rows to a multiple of 8*C_TILE and fold them into (8, cols) VPU
-    sublane layout. Returns (Xr [F*8,C], yr [8,C], wr [8,C], C, R); feature f
-    occupies Xr sublane rows 8f..8f+8."""
+def pack_rows_np(X, y, weights):
+    """THE numpy core of the kernel row layout: pad rows to a multiple of
+    8*C_TILE (X pads with 1.0 so no operator domain-faults on pads; w pads
+    with 0 so pads never weigh in) and fold into (8, cols) VPU sublane
+    layout. Returns host arrays (Xp [F*8,C], yp [8,C], wp [8,C]); feature f
+    occupies Xp sublane rows 8f..8f+8. Shared by _reshape_rows (device
+    upload) and the rows-sharded per-block packer
+    (models/device_search._make_score_data_rows) — ONE implementation of
+    the layout invariants."""
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
     F, R = X.shape
@@ -471,11 +476,18 @@ def _reshape_rows(X, y, weights):
     yp[:R] = y
     wp = np.zeros((R_pad,), np.float32)
     wp[:R] = 1.0 if weights is None else np.asarray(weights, np.float32)
+    return Xp.reshape(F * 8, C), yp.reshape(8, C), wp.reshape(8, C)
+
+
+def _reshape_rows(X, y, weights):
+    """pack_rows_np + device upload. Returns (Xr, yr, wr, C, R)."""
+    F, R = np.asarray(X).shape
+    Xp, yp, wp = pack_rows_np(X, y, weights)
     return (
-        jnp.asarray(Xp.reshape(F * 8, C)),
-        jnp.asarray(yp.reshape(8, C)),
-        jnp.asarray(wp.reshape(8, C)),
-        C,
+        jnp.asarray(Xp),
+        jnp.asarray(yp),
+        jnp.asarray(wp),
+        Xp.shape[1],
         R,
     )
 

@@ -74,36 +74,43 @@ def gather_slots(tree: Tree, src: jax.Array):
     sanitized and non-finite entries ride along as a small integer code,
     reconstructed after the gather.
 
-    Returns (kind, op, lhs, rhs, feat, val) gathered arrays."""
+    Returns (kind, op, lhs, rhs, feat, val) gathered arrays.
+
+    f64 engines (EvoConfig.val_dtype="float64"): constants cannot ride the
+    f32 matmul without rounding, so val takes the direct per-lane gather —
+    slower, but only the int fields dominate the surgery cost and those
+    still ride the MXU."""
     N = tree.n_slots
     oh = (src[:, None] == _iota(N)[None, :]).astype(jnp.float32)  # [N, N]
-    finite = jnp.isfinite(tree.val)
-    val_clean = jnp.where(finite, tree.val, 0.0)
-    # 0 finite, 1 nan, 2 +inf, 3 -inf — exact in f32
-    nf_code = jnp.where(
-        finite,
-        0,
-        jnp.where(jnp.isnan(tree.val), 1, jnp.where(tree.val > 0, 2, 3)),
-    ).astype(jnp.float32)
-    stacked = jnp.stack(
-        [
-            tree.kind.astype(jnp.float32),
-            tree.op.astype(jnp.float32),
-            tree.lhs.astype(jnp.float32),
-            tree.rhs.astype(jnp.float32),
-            tree.feat.astype(jnp.float32),
-            val_clean,
-            nf_code,
-        ],
-        axis=-1,
-    )  # [N, 7]
+    val_f32 = tree.val.dtype == jnp.float32
+    fields = [
+        tree.kind.astype(jnp.float32),
+        tree.op.astype(jnp.float32),
+        tree.lhs.astype(jnp.float32),
+        tree.rhs.astype(jnp.float32),
+        tree.feat.astype(jnp.float32),
+    ]
+    if val_f32:
+        finite = jnp.isfinite(tree.val)
+        val_clean = jnp.where(finite, tree.val, 0.0)
+        # 0 finite, 1 nan, 2 +inf, 3 -inf — exact in f32
+        nf_code = jnp.where(
+            finite,
+            0,
+            jnp.where(jnp.isnan(tree.val), 1, jnp.where(tree.val > 0, 2, 3)),
+        ).astype(jnp.float32)
+        fields += [val_clean, nf_code]
+    stacked = jnp.stack(fields, axis=-1)  # [N, 5 or 7]
     out = jnp.einsum("nm,mf->nf", oh, stacked, precision="highest")
-    code = out[:, 6].astype(jnp.int32)
-    val = jnp.where(
-        code == 0,
-        out[:, 5],
-        jnp.where(code == 1, jnp.nan, jnp.where(code == 2, jnp.inf, -jnp.inf)),
-    )
+    if val_f32:
+        code = out[:, 6].astype(jnp.int32)
+        val = jnp.where(
+            code == 0,
+            out[:, 5],
+            jnp.where(code == 1, jnp.nan, jnp.where(code == 2, jnp.inf, -jnp.inf)),
+        )
+    else:
+        val = tree.val[src]
     return (
         out[:, 0].astype(jnp.int32),
         out[:, 1].astype(jnp.int32),
@@ -252,6 +259,7 @@ def random_tree(
     nfeatures: int,
     n_unary: int,
     n_binary: int,
+    dtype=jnp.float32,
 ) -> Tree:
     """A uniform-ish random postorder tree with exactly ``m`` nodes
     (m clamped to [1, n_slots], adjusted down by 1 when no unary operators
@@ -320,7 +328,7 @@ def random_tree(
     feat = jax.random.randint(k3, (N,), 0, max(nfeatures, 1), dtype=jnp.int32).astype(jnp.int32)
     # independent key for values: reusing k_leaf here would correlate the
     # const/var coin with the value's sign (all constants would be negative)
-    val = jax.random.normal(k_val, (N,), jnp.float32)
+    val = jax.random.normal(k_val, (N,), dtype)
 
     # child pointers via stack simulation (N small; scalar-ish per step)
     def body(i, carry):

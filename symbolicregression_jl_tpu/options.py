@@ -192,12 +192,16 @@ class Options:
     # /root/reference/src/precompile.jl:36-93)
     jit_warmup: bool = True
     data_sharding: str | None = None  # "rows" to shard dataset rows over devices
-    # multi-output fits: run the per-output device-engine searches on a host
-    # thread pool so their device programs and host decode/simplify work
-    # overlap (the reference round-robins (output, population) work units in
-    # one scheduler, /root/reference/src/SymbolicRegression.jl:676-679).
-    # Serial fallback: non-device schedulers, multi-host runs, or False here.
-    parallel_outputs: bool = True
+    # multi-output fits: run the per-output searches on a host thread pool
+    # (ALL schedulers) so their device programs and host-side work overlap
+    # (the reference round-robins (output, population) work units in one
+    # scheduler, /root/reference/src/SymbolicRegression.jl:676-679).
+    # None (default) = auto: concurrent single-host, silently serial
+    # multi-host (the per-iteration cross-host exchange is per-output);
+    # True = explicit request, multi-host then warns about the serial
+    # fallback; False = always serial. Concurrent and serial execution are
+    # seed-for-seed identical (per-output RNG streams either way).
+    parallel_outputs: bool | None = None
 
     # -- derived (filled in __post_init__) -----------------------------------
     operators: OperatorSet = dataclasses.field(init=False)

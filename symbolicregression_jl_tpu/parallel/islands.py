@@ -53,6 +53,7 @@ def async_search_one_output(
     saved_state=None,
     verbosity: int = 1,
     output_file: str | None = None,
+    stdin_reader=None,
 ):
     """Async-island counterpart of search._search_one_output (same contract)."""
     from ..search import SearchResult, _init_population, _rescore_population, get_cur_maxsize
@@ -112,7 +113,10 @@ def async_search_one_output(
         warmup_host_programs(scorer, options)
     from ..utils.stdin_reader import StdinReader
 
-    stdin_reader = StdinReader()
+    # injected reader: shared by concurrent per-output searches, owner-closed
+    own_stdin = stdin_reader is None
+    if own_stdin:
+        stdin_reader = StdinReader()
     start_time = time.time()
     stop_reason: list = [None]
     cycles_left = [niterations] * n_islands
@@ -241,7 +245,8 @@ def async_search_one_output(
                 break
 
     iteration_seconds = time.time() - start_time
-    stdin_reader.close()
+    if own_stdin:
+        stdin_reader.close()
     recorder.dump()
     result = SearchResult(
         hall_of_fame=hof,

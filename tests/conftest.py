@@ -27,6 +27,7 @@ if "xla_cpu_enable_fast_math" not in prev:
     ).strip()
 
 import jax  # noqa: E402  (preloaded anyway; config must precede backend init)
+import pytest  # noqa: E402
 
 # SR_TPU_TESTS=1 keeps the real TPU platform (for tests/test_pallas.py etc.);
 # default is the 8-device virtual CPU platform.
@@ -35,3 +36,15 @@ if os.environ.get("SR_TPU_TESTS") != "1":
     jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables between test MODULES. The full suite
+    accumulates hundreds of distinct XLA:CPU programs in one process;
+    observed twice: the CPU backend segfaults inside backend_compile on a
+    late module's (perfectly valid — passes standalone) shard_map program
+    once that state is large. Bounding the live cache avoids the crash at
+    the cost of some per-module recompiles."""
+    yield
+    jax.clear_caches()

@@ -113,7 +113,10 @@ def test_device_mode_rejects_unsupported():
     assert device_mode_supported(
         _opts(loss_function=lambda tree, ds, o: 0.0)
     ) is not None
-    assert device_mode_supported(_opts(dtype="float64")) is not None
+    # round 5: f64 is an engine dtype now (the reference's DEFAULT dtype);
+    # complex stays CPU-committed on the host engines
+    assert device_mode_supported(_opts(dtype="float64")) is None
+    assert device_mode_supported(_opts(dtype="complex64")) is not None
 
 
 def test_device_search_multi_output():
@@ -153,3 +156,45 @@ def test_device_mutation_attempts_honored():
     with pytest.raises(ValueError, match="device_mutation_attempts"):
         Options(binary_operators=["+"], save_to_file=False,
                 device_mutation_attempts=0)
+
+
+def test_device_search_float64():
+    """f64 device engine (round 5): the reference's DEFAULT dtype runs on
+    the engine — f64 state arrays, interpreter scoring under x64, f64
+    readback. Frontier losses must match f64 host evaluation to f64
+    precision, and decoded constants must be genuine float64."""
+    X, y = _problem(n=128)
+    opts = _opts(dtype="float64", ncycles_per_iteration=60)
+    res = equation_search(
+        X.astype(np.float64), y.astype(np.float64), options=opts,
+        niterations=4, verbosity=0,
+    )
+    best = min(m.loss for m in res.pareto_frontier)
+    assert best < 1.5
+    X64 = X.astype(np.float64)
+    y64 = y.astype(np.float64)
+    for m in res.pareto_frontier:
+        pred = m.tree.eval_np(X64, opts.operators)
+        true = float(np.mean((pred - y64) ** 2))
+        # f64-tight agreement (an f32 round-trip would miss at ~1e-7 rel)
+        assert true == pytest.approx(m.loss, rel=1e-12, abs=1e-12), (
+            m.loss, true, m.tree.string_tree(opts.operators)
+        )
+
+
+def test_device_search_float64_batching():
+    """f64 + in-engine minibatching + batch const-opt + finalize program."""
+    X, y = _problem(n=300)
+    opts = _opts(
+        dtype="float64", batching=True, batch_size=64,
+        ncycles_per_iteration=40,
+    )
+    res = equation_search(
+        X.astype(np.float64), y.astype(np.float64), options=opts,
+        niterations=3, verbosity=0,
+    )
+    X64, y64 = X.astype(np.float64), y.astype(np.float64)
+    for m in res.pareto_frontier:
+        pred = m.tree.eval_np(X64, opts.operators)
+        true = float(np.mean((pred - y64) ** 2))
+        assert true == pytest.approx(m.loss, rel=1e-12, abs=1e-12)

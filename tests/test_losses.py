@@ -72,3 +72,31 @@ def test_logcosh_and_reference_aliases():
         np.asarray(resolve_loss("EpsilonInsLoss(0.5)")(p, t)),
         np.asarray(resolve_loss("L1EpsilonInsLoss(0.5)")(p, t)),
     )
+
+
+def test_lp_dist_loss_factory():
+    """LPDistLoss(p) — the generic p-norm loss the reference re-exports
+    (/root/reference/src/SymbolicRegression.jl:116): importable from the
+    package root, resolvable from the string form, and usable in a search."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.ops.losses import resolve_loss
+
+    f = sr.LPDistLoss(3.0)
+    assert float(np.asarray(f(np.float32(2.0), np.float32(0.0)))) == pytest.approx(8.0)
+    g = resolve_loss("LPDistLoss(1.5)")
+    assert float(np.asarray(g(np.float32(4.0), np.float32(0.0)))) == pytest.approx(8.0)
+    # end-to-end: a tiny search accepts the factory loss by string
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 48)).astype(np.float32)
+    y = (X[0] + X[1]).astype(np.float32)
+    res = sr.equation_search(
+        X, y,
+        options=sr.Options(
+            binary_operators=["+", "-"], unary_operators=[],
+            elementwise_loss="LPDistLoss(3)", populations=4,
+            population_size=16, ncycles_per_iteration=40, maxsize=6,
+            save_to_file=False, seed=0,
+        ),
+        niterations=4, verbosity=0,
+    )
+    assert min(m.loss for m in res.pareto_frontier) < 0.5

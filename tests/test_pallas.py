@@ -228,3 +228,74 @@ def test_loss_grad_kernel_masks_padded_rows():
     np.testing.assert_allclose(
         grads_k[0][0], float(np.asarray(grad0)[0]), rtol=1e-2
     )
+
+
+def test_rows_shard_block_pack_and_combine_match_full_data():
+    """The multi-chip rows-axis engine scores PER-BLOCK packs with the
+    kernel and psum-combines weighted means (models/device_search:
+    _make_score_data_rows + _build_score_fn's _combine). No multi-chip TPU
+    exists in this image, so pin the exact per-shard quantities on the one
+    real chip: block-local kernel means combined as sum(mean_s*wsum_s) /
+    sum(wsum_s) must equal the full-data kernel loss, and slicing the
+    concatenated pack along columns (what PartitionSpec(None, 'rows')
+    delivers to shard s) must recover block s's own pack bit-exactly."""
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        C_TILE,
+        P_TILE_LOSS,
+        _loss_pallas,
+        pack_flat_fused,
+        pack_rows_np,
+    )
+
+    rng = np.random.default_rng(0)
+    n_sh = 2
+    R_local = 8 * C_TILE  # one exact tile per block: no pad rows in-block
+    R = n_sh * R_local
+    X = rng.normal(size=(3, R)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+    w = (np.abs(rng.normal(size=(R,))) + 0.1).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        maxsize=14, save_to_file=False,
+    )
+    opset, loss_elem = opts.operators, opts.loss
+    trees = Population.random_trees(32, opts, 3, rng)
+    flat = flatten_trees(trees, opts.max_nodes)
+    ints, vals = pack_flat_fused(flat, opset)
+    N = opts.max_nodes
+
+    def kernel_loss(Xb, yb, wb, Rb):
+        Xp, yp, wp = pack_rows_np(Xb, yb, wb)
+        C = Xp.shape[1]
+        out = _loss_pallas(
+            ints, vals, jnp.asarray(Xp), jnp.asarray(yp), jnp.asarray(wp),
+            opset, loss_elem, N, P_TILE_LOSS, C_TILE, C, Rb,
+        )
+        return np.asarray(out), float(wp.sum())
+
+    # per-block means + weight totals, combined exactly like the rows psum
+    num = np.zeros(32, np.float64)
+    den = 0.0
+    packs = []
+    for s in range(n_sh):
+        sl = slice(s * R_local, (s + 1) * R_local)
+        mean_s, wsum_s = kernel_loss(X[:, sl], y[sl], w[sl], R_local)
+        num += mean_s.astype(np.float64) * wsum_s
+        den += wsum_s
+        packs.append(pack_rows_np(X[:, sl], y[sl], w[sl]))
+    combined = num / den
+
+    full, _ = kernel_loss(X, y, w, R)
+    m = np.isfinite(full)
+    assert m.sum() >= 16
+    np.testing.assert_array_equal(np.isfinite(combined), m)
+    np.testing.assert_allclose(combined[m], full[m], rtol=2e-5, atol=1e-6)
+
+    # sharding-slice equivalence: the concatenated pack's column slice s IS
+    # block s's pack (the placement contract of _make_score_data_rows)
+    Xr_all = np.concatenate([p[0] for p in packs], axis=1)
+    C_local = packs[0][0].shape[1]
+    for s in range(n_sh):
+        np.testing.assert_array_equal(
+            Xr_all[:, s * C_local : (s + 1) * C_local], packs[s][0]
+        )

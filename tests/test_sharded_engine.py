@@ -168,3 +168,181 @@ def test_device_search_engages_mesh_end_to_end():
     assert all(
         m.tree.count_nodes() >= 1 for p in res.populations for m in p.members
     )
+
+
+# ---------------------------------------------------------------------------
+# rows axis: dataset rows sharded over the mesh (round 5, SURVEY §5.7)
+# ---------------------------------------------------------------------------
+
+from symbolicregression_jl_tpu.models.device_search import (  # noqa: E402
+    _make_const_opt_fn,
+    _shard_const_opt,
+    score_data_specs,
+)
+from jax.sharding import PartitionSpec as PSpec  # noqa: E402
+
+
+def _rows_score_call(mesh, score_fn, data):
+    specs = score_data_specs(data)
+    return jax.jit(
+        jax.shard_map(
+            lambda b, d: score_fn(b, d), mesh=mesh,
+            in_specs=(PSpec(), specs), out_specs=PSpec(), check_vma=False,
+        )
+    )
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_rows_sharded_scoring_matches_unsharded(weighted):
+    """The psum-combined weighted mean over 4 rows shards must equal the
+    single-device full-data loss exactly (incl. inf for invalid trees)."""
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+    w = (
+        (np.abs(rng.normal(size=(64,))) + 0.1).astype(np.float32)
+        if weighted
+        else None
+    )
+    options = Options(
+        binary_operators=["+", "-", "*", "/"], unary_operators=["cos", "log"],
+        maxsize=14, save_to_file=False, scheduler="device",
+    )
+    mesh = make_mesh(2, 4, jax.devices()[:8])
+    fn_r, data_r = _make_score_fn(
+        X, y, w, options, use_pallas=False,
+        rows_axis="rows", rows_shards=4, mesh=mesh,
+    )
+    fn_u, data_u = _make_score_fn(X, y, w, options, use_pallas=False)
+    trees = Population.random_trees(48, options, 2, np.random.default_rng(3))
+    flat = flatten_trees(trees, options.max_nodes)
+    from symbolicregression_jl_tpu.ops.treeops import Tree
+
+    batch = Tree(*(jnp.asarray(a) for a in flat))
+    got = np.asarray(_rows_score_call(mesh, fn_r, data_r)(batch, data_r))
+    want = np.asarray(fn_u.jitted(batch, data_u))
+    # log produces infs on some random trees: inf-ness must agree exactly
+    np.testing.assert_array_equal(np.isfinite(got), np.isfinite(want))
+    m = np.isfinite(want)
+    assert m.sum() >= 10
+    np.testing.assert_allclose(got[m], want[m], rtol=1e-5, atol=1e-6)
+
+
+def test_rows_sharded_engine_2d_mesh_frontier_and_const_opt():
+    """Full engine iterations + const-opt on a (pop=2, rows=4) mesh: the
+    rows-replicated state must stay consistent (every decoded member's
+    stored loss equals its host full-data eval), which fails if any loss or
+    gradient the engine consumed was shard-local instead of psum-combined."""
+    from symbolicregression_jl_tpu.ops.flat import FlatTrees, unflatten_tree
+
+    options, X, y, cfg_g, flat, init_losses, fn_u, data_u = _setup(ncycles=4)
+    I, P = cfg_g.n_islands, cfg_g.pop_size
+    mesh = make_mesh(2, 4, jax.devices()[:8])
+    fn_r, data_r = _make_score_fn(
+        X, y, None, options, use_pallas=False,
+        rows_axis="rows", rows_shards=4, mesh=mesh,
+    )
+    specs = score_data_specs(data_r)
+    cfg_l = build_evo_config(
+        options, n_features=2, baseline_loss=cfg_g.baseline_loss,
+        use_baseline=True, niterations=4, n_islands=I // 2,
+    )
+    state = init_state(flat, init_losses, cfg_g, seed=13)
+    state = shard_evo_state(state, mesh)
+    step = make_sharded_iteration(mesh, cfg_l, fn_r, data_specs=specs)
+    st = step(state, data_r)
+    st = step(st, data_r)
+    copt = _shard_const_opt(
+        mesh,
+        _make_const_opt_fn(options, cfg_l, has_w=False, axis="pop", rows_axis="rows"),
+        specs,
+    )
+    st = copt(st, data_r)
+
+    # every live member's stored loss is the true full-data loss
+    kind, op, lhs, rhs, feat, val = (
+        np.asarray(st.kind), np.asarray(st.op), np.asarray(st.lhs),
+        np.asarray(st.rhs), np.asarray(st.feat), np.asarray(st.val),
+    )
+    length = np.asarray(st.length)
+    loss = np.asarray(st.loss)
+    Xd = X.astype(np.float64)
+    n_checked = 0
+    for i in range(I):
+        fl = FlatTrees(kind[i], op[i], lhs[i], rhs[i], feat[i], val[i], length[i])
+        for p in range(P):
+            if length[i, p] < 1 or not np.isfinite(loss[i, p]):
+                continue
+            tree = unflatten_tree(fl, p)
+            pred = tree.eval_np(Xd, options.operators)
+            true = float(np.mean((pred - y.astype(np.float64)) ** 2))
+            assert true == pytest.approx(float(loss[i, p]), rel=1e-3, abs=1e-4), (
+                i, p, tree.string_tree(options.operators)
+            )
+            n_checked += 1
+    assert n_checked >= I * P // 2
+    # frontier too
+    bs_loss = np.asarray(st.bs_loss)
+    bs_exists = np.asarray(st.bs_exists)
+    kindb, opb, lhsb, rhsb, featb, valb, blen = (np.asarray(a) for a in st.bs_tree)
+    bsf = FlatTrees(kindb, opb, lhsb, rhsb, featb, valb, blen.astype(np.int32))
+    for s in range(cfg_g.maxsize + 1):
+        if not bs_exists[s] or blen[s] < 1:
+            continue
+        tree = unflatten_tree(bsf, s)
+        pred = tree.eval_np(Xd, options.operators)
+        true = float(np.mean((pred - y.astype(np.float64)) ** 2))
+        assert true == pytest.approx(float(bs_loss[s]), rel=1e-3, abs=1e-5)
+
+
+def test_device_search_rows_sharding_end_to_end():
+    """data_sharding='rows' routes the device scheduler onto a rows-axis
+    mesh (8 virtual devices -> rows=8 here) and still solves the planted
+    problem with full-data-honest frontier losses."""
+    X, y = _problem(n=400)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=5,  # deliberately NOT divisible by 8: rows axis absorbs
+        population_size=16,
+        ncycles_per_iteration=60,
+        maxsize=14,
+        data_sharding="rows",
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    res = equation_search(X, y, options=options, niterations=5, verbosity=0)
+    best = min(m.loss for m in res.pareto_frontier)
+    assert best < 1.5
+    for m in res.pareto_frontier:
+        pred = m.tree.eval_np(X.astype(np.float64), options.operators)
+        true = float(np.mean((pred - y.astype(np.float64)) ** 2))
+        assert true == pytest.approx(m.loss, rel=1e-3, abs=1e-4)
+
+
+def test_device_search_rows_sharding_with_batching():
+    """rows sharding + in-engine minibatching (the config-5 shape): per-shard
+    fresh subsets, psum-combined batch losses, full-data finalize."""
+    X, y = _problem(n=800)
+    options = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        batching=True,
+        batch_size=64,
+        data_sharding="rows",
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    res = equation_search(X, y, options=options, niterations=4, verbosity=0)
+    best = min(m.loss for m in res.pareto_frontier)
+    assert best < 2.0
+    for m in res.pareto_frontier:
+        pred = m.tree.eval_np(X.astype(np.float64), options.operators)
+        true = float(np.mean((pred - y.astype(np.float64)) ** 2))
+        assert true == pytest.approx(m.loss, rel=1e-3, abs=1e-4)

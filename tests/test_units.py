@@ -195,3 +195,97 @@ def test_search_with_units_penalizes_violations():
     # the recovered equation must itself be dimensionally consistent
     assert not violates_dimensional_constraints(best.tree, res.dataset, opts)
     assert best.loss < 1000.0  # no penalty baked into the winner
+
+
+# ---------------------------------------------------------------------------
+# device engine units (round 5): in-jit WildcardQuantity abstract eval
+# ---------------------------------------------------------------------------
+
+
+def test_engine_dim_check_matches_host_oracle():
+    """ops/evolve._dim_violates (in-jit, structure-only) must agree with the
+    host checker on random trees whose sample values stay finite (the
+    documented deviation covers only non-finite-value latching)."""
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.device_search import build_evo_config
+    from symbolicregression_jl_tpu.ops.evolve import _dim_violates
+    from symbolicregression_jl_tpu.ops.flat import flatten_trees
+    from symbolicregression_jl_tpu.ops.treeops import Tree
+
+    opts = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "sqrt", "square"],
+        maxsize=16,
+        save_to_file=False,
+    )
+    rng = np.random.default_rng(0)
+    X = (np.abs(rng.normal(size=(2, 16))) + 0.5).astype(np.float32)
+    ds = Dataset(X, (2 * X[0]).astype(np.float32), X_units=["m", "s"], y_units="m")
+    cfg = build_evo_config(
+        opts, n_features=2, baseline_loss=1.0, use_baseline=True,
+        niterations=1, dataset=ds,
+    )
+    assert cfg.units_check
+    ops = opts.operators
+    from symbolicregression_jl_tpu.tree import binary, constant, feature, unary
+
+    def rand_tree(depth):
+        if depth == 0 or rng.random() < 0.3:
+            return (
+                constant(float(np.abs(rng.normal()) + 0.2))
+                if rng.random() < 0.5
+                else feature(int(rng.integers(0, 2)))
+            )
+        if rng.random() < 0.4:
+            return unary(int(rng.integers(0, ops.n_unary)), rand_tree(depth - 1))
+        return binary(
+            int(rng.integers(0, ops.n_binary)),
+            rand_tree(depth - 1), rand_tree(depth - 1),
+        )
+
+    trees = [rand_tree(3) for _ in range(120)]
+    flat = flatten_trees(trees, opts.max_nodes)
+    n_viol = 0
+    for i, t in enumerate(trees):
+        want = violates_dimensional_constraints(t, ds, opts)
+        row = Tree(*(jnp.asarray(a[i]) for a in flat[:6]), jnp.asarray(flat.length[i]))
+        got = bool(_dim_violates(row, cfg))
+        assert got == want, t.string_tree(ops)
+        n_viol += want
+    assert n_viol >= 10  # the sample must exercise violations
+
+
+def test_device_search_with_units():
+    """Units on the DEVICE engine: the in-jit dimensional penalty must steer
+    the search to unit-consistent winners, and every frontier loss must
+    equal host full-data loss + host penalty (engine/host consistency)."""
+    rng = np.random.default_rng(0)
+    X = (np.abs(rng.normal(size=(2, 80))) + 0.5).astype(np.float32)
+    y = (2.0 * X[0]).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    res = equation_search(
+        X, y, options=opts, niterations=3, verbosity=0,
+        X_units=["m", "s"], y_units="m",
+    )
+    best = min(res.pareto_frontier, key=lambda m: m.loss)
+    assert not violates_dimensional_constraints(best.tree, res.dataset, opts)
+    assert best.loss < 1000.0
+    for m in res.pareto_frontier:
+        pred = m.tree.eval_np(X.astype(np.float64), opts.operators)
+        true = float(np.mean((pred - y.astype(np.float64)) ** 2))
+        if violates_dimensional_constraints(m.tree, res.dataset, opts):
+            true += 1000.0
+        assert true == pytest.approx(m.loss, rel=1e-3, abs=1e-3), (
+            m.tree.string_tree(opts.operators)
+        )
