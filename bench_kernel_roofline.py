@@ -158,26 +158,40 @@ def rows_sweep(P_sweep: int = 512):
         Xr, yr, wr, C, Rr = _reshape_rows(X, y, None)
 
         def make_chain(K):
+            # fori_loop, not an unrolled Python loop: K must grow into the
+            # hundreds at small R (the ~100ms tunnel dispatch overhead would
+            # otherwise swamp a ~1ms kernel sweep and the lstsq slope goes
+            # negative — observed on the first committed run of this sweep)
             @jax.jit
             def fK(ints, vals):
-                acc = jnp.zeros((P_sweep,), jnp.float32)
-                for k in range(K):
-                    v = vals + (k + 1) * 1e-7
+                def body(k, acc):
+                    v = vals + (k + 1).astype(jnp.float32) * 1e-7
                     out = _loss_pallas(
                         ints, v, Xr, yr, wr, opset, loss_elem,
                         N, P_TILE_LOSS, C_TILE, C, Rr,
                     )
-                    acc = acc + jnp.where(jnp.isfinite(out), out, 0.0)
-                return acc
+                    return acc + jnp.where(jnp.isfinite(out), out, 0.0)
+
+                return jax.lax.fori_loop(
+                    0, K, body, jnp.zeros((P_sweep,), jnp.float32)
+                )
 
             return fK
 
         _ = np.asarray(make_chain(1)(ints, vals))  # sync regime + compile
+        # size the chain so K_max x kernel time >> dispatch noise: calibrate
+        # from a K=1 vs K=33 probe, then target ~0.5s for the longest chain
+        f1, f33 = make_chain(1), make_chain(33)
+        _ = np.asarray(f1(ints, vals)); _ = np.asarray(f33(ints, vals))
+        t0 = time.time(); _ = np.asarray(f1(ints, vals)); t1 = time.time() - t0
+        t0 = time.time(); _ = np.asarray(f33(ints, vals)); t33 = time.time() - t0
+        per_sweep = max((t33 - t1) / 32.0, 1e-5)
+        K_max = int(np.clip(0.5 / per_sweep, 8, 1024))
         pts = []
-        for K in (1, 2, 4):
+        for K in (1, K_max // 4, K_max // 2, K_max):
             fK = make_chain(K)
             _ = np.asarray(fK(ints, vals))
-            reps = 4
+            reps = 3
             t0 = time.time()
             for _i in range(reps):
                 _ = np.asarray(fK(ints, vals))

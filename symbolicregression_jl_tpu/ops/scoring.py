@@ -81,7 +81,9 @@ def batched_loss_jit(flat, X, y, weights, opset, loss_elem, use_pallas=False) ->
     if use_pallas:
         return batched_loss(flat, X, y, weights, opset, loss_elem, True)
     has_weights = weights is not None
-    w = weights if has_weights else jnp.zeros((), X.dtype)
+    # numpy placeholder, not jnp: jnp.zeros would eagerly allocate on the
+    # DEFAULT device, which breaks CPU-committed complex data on TPU hosts
+    w = weights if has_weights else np.zeros((), X.dtype)
     return _batched_loss_jit(flat, X, y, w, opset, loss_elem, has_weights)
 
 

@@ -169,3 +169,23 @@ def test_complex_constant_parse_round_trip():
     s = t.string_tree(ops, precision=17)
     back = parse_equation(s, ops)
     assert t.same_structure(back), (s, back.string_tree(ops))
+
+
+def test_complex_search_on_accelerator_default_backend():
+    """Regression: on a host whose DEFAULT backend is an accelerator, every
+    array the ℂ path touches must stay CPU-committed — XLA:TPU implements no
+    complex arithmetic, so one eager jnp constructor on the default device
+    (e.g. the weights placeholder in ops/scoring.batched_loss_jit) fails the
+    whole search with UNIMPLEMENTED. Runs only under SR_TPU_TESTS=1."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        pytest.skip("needs a non-CPU default backend (SR_TPU_TESTS=1)")
+    X, y = _planted(n=50)
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        dtype=np.complex64, populations=2, population_size=12,
+        ncycles_per_iteration=20, maxsize=10, seed=0, save_to_file=False,
+    )
+    res = equation_search(X, y, options=opts, niterations=2, verbosity=0)
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
