@@ -178,10 +178,10 @@ def rows_sweep(P_sweep: int = 512):
 
             return fK
 
-        _ = np.asarray(make_chain(1)(ints, vals))  # sync regime + compile
         # size the chain so K_max x kernel time >> dispatch noise: calibrate
         # from a K=1 vs K=33 probe, then target ~0.5s for the longest chain
         f1, f33 = make_chain(1), make_chain(33)
+        _ = np.asarray(f1(ints, vals))  # sync regime + compile
         _ = np.asarray(f1(ints, vals)); _ = np.asarray(f33(ints, vals))
         t0 = time.time(); _ = np.asarray(f1(ints, vals)); t1 = time.time() - t0
         t0 = time.time(); _ = np.asarray(f33(ints, vals)); t33 = time.time() - t0

@@ -52,16 +52,36 @@ def device_mode_supported(options: Options) -> str | None:
     dataset-dependent exclusions (units run in-jit, rows sharding grows the
     engine mesh)."""
     if options.loss_function is not None:
-        return "custom full-objective loss_function"
-    if options.complexity_mapping is not None:
-        return "custom complexity mapping"
+        return (
+            "custom full-objective loss_function (host-callable per-tree "
+            "objectives cannot run inside a compiled program; JAX-traceable "
+            "objectives over the prediction matrix run in-engine via "
+            "Options.loss_function_jit)"
+        )
+    if options.loss_function_jit is not None and options.data_sharding == "rows":
+        return (
+            "loss_function_jit with data_sharding='rows' (cross-shard "
+            "combination of an arbitrary objective is undefined; the "
+            "engine's psum combine is specific to weighted-mean losses)"
+        )
+    # custom complexity mappings are honored in-jit (round 5): every engine
+    # complexity consumer routes through ops/evolve._complexity_of (score
+    # parsimony, curmaxsize validation, mutation conditioning, frequency
+    # histogram, tournament parsimony, best-seen frontier slots, migration)
+    if options.use_recorder and options.device_mutation_attempts > 1:
+        # the event log records ONE (kind, candidate) per lane; multi-attempt
+        # lanes would mis-attribute the surviving candidate's kind
+        return "recorder with device_mutation_attempts > 1"
     # data_sharding="rows" is honored: on multi-device hosts the engine mesh
     # grows a 'rows' axis (psum-combined scoring + const-opt); on one device
     # all rows are local anyway. Units are honored too (round 5): the engine
     # runs the WildcardQuantity abstract eval in-jit (ops/evolve._dim_violates)
-    # with the additive dimensional-regularization penalty.
-    if options.use_recorder:
-        return "recorder (mutation lineage tracing)"
+    # with the additive dimensional-regularization penalty. The recorder is
+    # honored too (round 5): engine programs return per-event logs that the
+    # host replays into mutation/death/tuning lineage with exact
+    # parent/child trees (ops/evolve.record_events +
+    # models/device_recorder.py; single-process, single-device — a recorder
+    # run is a debugging session, not a scale run).
     if options.graph_nodes:
         return "GraphNode shared-subtree DAGs"
     # f32 AND f64 are engine dtypes (the reference defaults to Float64,
@@ -169,7 +189,30 @@ def build_evo_config(
             else 1.0
         ),
         val_dtype=str(np.dtype(options.dtype)),
+        complexity_table=_complexity_table(options, n_features),
+        record_events=bool(options.use_recorder),
         **_units_config(options, dataset, n_features),
+    )
+
+
+def _complexity_table(options: Options, n_features: int):
+    """Static per-node cost tables for the engine's mapped complexity
+    (reference: ComplexityMapping, /root/reference/src/OptionsStruct.jl:21-113);
+    None -> node count."""
+    cm = options.complexity_mapping
+    if cm is None:
+        return None
+    var = np.asarray(cm["variable"], dtype=np.float64)
+    var_costs = (
+        (float(var),) * max(n_features, 1)
+        if var.ndim == 0
+        else tuple(float(v) for v in var)
+    )
+    return (
+        tuple(float(c) for c in cm["binop"]),
+        tuple(float(c) for c in cm["unaop"]),
+        float(cm["constant"]),
+        var_costs,
     )
 
 
@@ -269,6 +312,7 @@ def _make_score_fn(
     fn_key = (
         options.operators,
         options.loss,
+        options.loss_function_jit,
         options.max_nodes,
         use_pallas,
         options.batching and options.batch_size,
@@ -534,9 +578,12 @@ def _build_score_fn(
 
         return score_fn
 
-    # scan-interpreter fallback (CPU tests, non-lowerable operator sets)
+    # scan-interpreter fallback (CPU tests, non-lowerable operator sets,
+    # traceable full objectives)
     from ..ops.interp import eval_trees
     from ..ops.losses import weighted_mean_loss
+
+    objective = options.loss_function_jit
 
     def score_fn(batch, data: ScoreData, key=None):
         flat = FlatTrees(
@@ -556,8 +603,15 @@ def _build_score_fn(
             ws = None if data.wd is None else data.wd[idx]
             wsum = _batch_wsum(data, idx)
         preds = eval_trees(flat, Xs, opset)
-        elem = loss_elem(preds, ys[None, :])
-        losses = weighted_mean_loss(elem, None if ws is None else ws[None, :])
+        if objective is not None:
+            # traceable full objective (Options.loss_function_jit); rows
+            # sharding is excluded by device_mode_supported so no _combine
+            losses = jnp.asarray(objective(preds, ys, ws))
+        else:
+            elem = loss_elem(preds, ys[None, :])
+            losses = weighted_mean_loss(
+                elem, None if ws is None else ws[None, :]
+            )
         ok = jnp.isfinite(preds).all(axis=-1)
         return _combine(jnp.where(ok, losses, jnp.inf), wsum)
 
@@ -593,8 +647,22 @@ def _make_const_opt_fn(
     import jax.numpy as jnp
     from jax import lax
 
-    from ..ops.constant_opt import _bfgs_single, remat_tree_loss
+    from ..ops.constant_opt import (
+        _bfgs_single,
+        _neldermead_single,
+        remat_tree_loss,
+    )
     from ..ops.interp import _Structure
+
+    # honor the configured algorithm (reference: opt_algorithm dispatch,
+    # /root/reference/src/ConstantOptimization.jl:44-78) — Newton stays the
+    # host path's 1-constant special case; the batched engine uses one
+    # algorithm for the whole masked batch
+    optimize_single = (
+        _neldermead_single
+        if options.optimizer_algorithm == "NelderMead"
+        else _bfgs_single
+    )
 
     I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
     # fixed-size subset (jit needs static shapes): expected count under the
@@ -633,7 +701,10 @@ def _make_const_opt_fn(
             wd = data.wd[idx] if has_w else jnp.zeros((), jnp.float32)
         # closures over traced args are trace-safe; building them here keeps
         # the executable dataset-independent
-        loss_fn = remat_tree_loss(opset, loss_elem, Xd, yd, wd, has_w)
+        loss_fn = remat_tree_loss(
+            opset, loss_elem, Xd, yd, wd, has_w,
+            objective=options.loss_function_jit,
+        )
         combine = None
         if rows_axis is not None:
             wsum = (
@@ -661,7 +732,7 @@ def _make_const_opt_fn(
 
         def per_tree(struct_p, starts_p, mask_p):
             def per_restart(v0):
-                return _bfgs_single(
+                return optimize_single(
                     loss_fn, v0, struct_p, Xd, yd, wd, has_w, mask_p, iters,
                     combine=combine,
                 )
@@ -784,8 +855,11 @@ def _accept_and_scatter(
     improved = (fbest < base) & has_consts
     new_val = jnp.where(improved[:, None], vals, val0)
     new_loss = jnp.where(improved, fbest, old_loss)
-    comp = state.length[ii, pp].astype(jnp.float32)
-    new_score = _score_of(new_loss, comp, cfg, norm)
+    from ..ops.evolve import _complexity_members
+
+    # const-opt only retunes constants; mapped complexity is value-independent
+    comp_m = _complexity_members(state, cfg)[ii, pp]
+    new_score = _score_of(new_loss, comp_m.astype(jnp.float32), cfg, norm)
     if cfg.copt_updates_bs and not cfg.batching:
         # Fold the tuned members into the best-seen frontier. Without this,
         # optimized constants lived only in the population: the in-jit hof
@@ -808,9 +882,10 @@ def _accept_and_scatter(
         ]
         valid = jnp.isfinite(new_loss) & (lengths >= 1)
         state = merge_best_seen(
-            state, cfg, new_loss, valid, fields, lengths, axis=axis
+            state, cfg, new_loss, valid, fields, lengths, axis=axis,
+            comps=comp_m,
         )
-    return state._replace(
+    state = state._replace(
         val=state.val.at[ii, pp].set(new_val),
         loss=state.loss.at[ii, pp].set(new_loss),
         score=state.score.at[ii, pp].set(new_score),
@@ -820,6 +895,15 @@ def _accept_and_scatter(
         key=key,
         num_evals=state.num_evals + n_evals,
     )
+    if not cfg.record_events:
+        return state
+    # recorder tuning log (reference: 'tuning' events on optimized members,
+    # /root/reference/src/SingleIteration.jl:140-171); new_val lets the host
+    # replay keep its tree mirror exact
+    return state, {
+        "ii": ii, "pp": pp, "improved": improved,
+        "new_loss": new_loss, "new_val": new_val,
+    }
 
 
 def _make_const_opt_fn_pallas(
@@ -1170,8 +1254,17 @@ def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
             continue
         tree = unflatten_tree(flat, s)
         loss = float(bs_loss[s])
-        score = float(_score_of(loss, float(bs_len[s]), cfg))
-        m = PopMember(tree, score, loss, complexity=int(bs_len[s]))
+        if cfg.complexity_table is None:
+            comp = int(bs_len[s])
+        else:
+            # mapped complexity: recompute host-side from the decoded tree
+            # (the frontier SLOT s is already the mapped complexity, but the
+            # exact value is what PopMember/hof consumers use)
+            from ..complexity import compute_complexity
+
+            comp = compute_complexity(tree, options)
+        score = float(_score_of(loss, float(comp), cfg))
+        m = PopMember(tree, score, loss, complexity=comp)
         members.append(m)
     return members
 
@@ -1249,6 +1342,8 @@ def device_search_one_output(
     verbosity: int = 1,
     output_file: str | None = None,
     stdin_reader=None,
+    recorder=None,
+    out_j: int = 1,
 ):
     """Run one output's search on the device engine. Returns SearchResult
     (same contract as models/../search._search_one_output)."""
@@ -1264,6 +1359,16 @@ def device_search_one_output(
             f"scheduler='device' cannot honor this configuration ({reason}); "
             "use scheduler='lockstep'"
         )
+    if options.use_recorder and jax.process_count() > 1:
+        raise ValueError(
+            "use_recorder is single-process: lineage replay cannot see other "
+            "processes' events (run the recorder session un-distributed)"
+        )
+    own_recorder = recorder is None
+    if own_recorder:
+        from ..utils.recorder import Recorder
+
+        recorder = Recorder(options)
 
     # --- multi-host (SPMD over DCN): every process runs this same function on
     # its own island slice; the only cross-host traffic is the once-per-
@@ -1357,7 +1462,9 @@ def device_search_one_output(
     # same shape. cfg (real baseline) stays for host-side score decoding.
     ecfg = dataclasses.replace(cfg, baseline_loss=1.0, use_baseline=True)
     cfg_local = ecfg
-    if n_dev > 1:
+    # recorder mode stays single-device: the sharded iteration's out_specs
+    # describe EvoState only, and a recorder session is a debugging run
+    if n_dev > 1 and not options.use_recorder:
         if options.data_sharding == "rows":
             # rows-first split (SURVEY §5.7: big-n configs want the row axis):
             # the largest rows axis dividing the row count whose leftover pop
@@ -1392,7 +1499,13 @@ def device_search_one_output(
     # the Pallas kernels are f32-only; f64 engines score through the scan
     # interpreter (XLA emulates f64 on TPU — correctness over speed, like
     # the reference's Float64 default path)
-    use_pallas = jax.devices()[0].platform != "cpu" and eng_dt == np.float32
+    use_pallas = (
+        jax.devices()[0].platform != "cpu"
+        and eng_dt == np.float32
+        # the fused kernel reduces elementwise loss in-pass; a traceable
+        # full objective needs the [B, R] prediction matrix -> interp path
+        and options.loss_function_jit is None
+    )
     if use_pallas:
         from ..ops.interp_pallas import pallas_supported
 
@@ -1400,7 +1513,14 @@ def device_search_one_output(
             options.operators, dataset.n_features, options.loss
         )
     use_pallas_grad = False
-    if use_pallas and options.should_optimize_constants:
+    # the fused Pallas loss+grad path implements BFGS only; NelderMead must
+    # take the interpreter const-opt path below so the configured algorithm
+    # is honored (not silently swapped for BFGS)
+    if (
+        use_pallas
+        and options.should_optimize_constants
+        and options.optimizer_algorithm == "BFGS"
+    ):
         from ..ops.interp_pallas import pallas_grad_supported
 
         use_pallas_grad = pallas_grad_supported(
@@ -1518,11 +1638,30 @@ def device_search_one_output(
     state = init_state(flat, np.zeros(I * P), ecfg, seed)
     # overwrite host-zero losses with the device-computed ones (keeps the
     # whole init path free of device->host copies)
-    comp = state.length.astype(jnp.float32)
+    from ..ops.evolve import _complexity_members
+
+    comp = _complexity_members(state, ecfg).astype(jnp.float32)
     loss_dev = init_losses.reshape(I, P)
     state = state._replace(
         loss=loss_dev, score=_score_of(loss_dev, comp, cfg)  # real-baseline
     )
+
+    replay = None
+    if options.use_recorder:
+        from .device_recorder import EngineLineageReplay
+
+        vdt_np = np.dtype(ecfg.val_dtype)
+        state0 = tuple(
+            np.asarray(a).reshape((I, P) + np.shape(a)[1:])
+            for a in (
+                flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat,
+                np.asarray(flat.val, vdt_np), flat.length,
+            )
+        )
+        replay = EngineLineageReplay(
+            state0, options, recorder, out_j=out_j, cfg=cfg,
+            loss0=np.asarray(state.loss), score0=np.asarray(state.score),
+        )
 
     if mesh is not None:
         from ..ops.evolve import make_sharded_iteration, shard_evo_state
@@ -1688,12 +1827,23 @@ def device_search_one_output(
 
     for it in range(niterations):
         state = run_step(state, score_data)
+        if replay is not None:
+            state, iter_log = state
+            replay.consume_iteration(iter_log)
         if copt_step is not None:
             state = copt_step(state, score_data)
+            if replay is not None:
+                state, tuning_log = state
+                replay.consume_tuning(tuning_log)
         if fin_step is not None:
             # batching: full-data finalize AFTER the batch const-opt, so the
             # readback below only ever sees exact losses
             state = fin_step(state, score_data)
+            if replay is not None:
+                state, fin_log = state
+                for mk in ("mig_island", "mig_hof"):
+                    if mk in fin_log:
+                        replay.consume_migration(fin_log[mk])
         buf = np.asarray(readback_step(state))  # the iteration's ONE readback
 
         if multi_host:
@@ -1767,6 +1917,25 @@ def device_search_one_output(
                     state, ecfg, pool, float(options.fraction_replaced_hof),
                     score_data.norm,
                 )
+                if replay is not None:
+                    state, mig_log = state
+                    replay.consume_migration(mig_log)
+
+        if replay is not None:
+            # authoritative per-iteration population snapshot (the recorder's
+            # out{j}_pop{i} entries; host engines record per iteration too).
+            # This extra full-state readback is recorder overhead only.
+            replay.snapshot_populations(
+                tuple(
+                    np.asarray(a)
+                    for a in (
+                        state.kind, state.op, state.lhs, state.rhs,
+                        state.feat, state.val, state.length, state.loss,
+                        state.score,
+                    )
+                ),
+                it + 1,
+            )
 
         # count AFTER the iteration's host-triggered rescore/simplify evals so
         # the max_evals stop and the returned total see them immediately
@@ -1848,11 +2017,17 @@ def device_search_one_output(
             tree = unflatten_tree(flat_i, p)
             m = PopMember(
                 tree, float(score[i, p]), float(loss[i, p]),
-                complexity=int(length[i, p]),
+                # node count sans mapping; None -> get_complexity computes
+                # the mapped value lazily with Options.complexity_mapping
+                complexity=(
+                    int(length[i, p]) if cfg.complexity_table is None else None
+                ),
             )
             members.append(m)
             if multi_host:
-                final_slots.append((i, p))  # deferred: lockstep sync below
+                # deferred: lockstep sync below (carry the MAPPED complexity
+                # so the exchange bins match hof slots under complexity_of_*)
+                final_slots.append((i, p, m.get_complexity(options)))
             else:
                 hof.update(m, options)
         pops.append(Population(members))
@@ -1869,8 +2044,8 @@ def device_search_one_output(
         fl = np.full((S1,), np.inf, vdt_np)
         fn_ = np.zeros((S1,), vdt_np)
         ffields = [np.zeros((S1, N), vdt_np) for _ in range(6)]
-        for i, p in final_slots:
-            s = min(int(length[i, p]), cfg.maxsize)
+        for i, p, comp_ip in final_slots:
+            s = min(int(comp_ip), cfg.maxsize)
             if np.isfinite(loss[i, p]) and loss[i, p] < fl[s]:
                 fl[s] = loss[i, p]
                 fn_[s] = length[i, p]
@@ -1906,4 +2081,6 @@ def device_search_one_output(
     # loop-only wall time (compile/warmup/setup excluded): the honest
     # denominator for end-to-end throughput (bench.py e2e_main)
     result.iteration_seconds = iteration_seconds
+    if own_recorder:
+        recorder.dump()
     return result

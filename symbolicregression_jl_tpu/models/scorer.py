@@ -21,7 +21,12 @@ import numpy as np
 from ..dataset import Dataset
 from ..ops.flat import batch_bucket as _bucket
 from ..ops.flat import flatten_trees
-from ..ops.scoring import batched_loss_jit, baseline_loss, loss_to_score
+from ..ops.scoring import (
+    batched_loss_jit,
+    baseline_loss,
+    loss_to_score,
+    objective_loss_jit,
+)
 from ..tree import Node
 
 __all__ = ["BatchScorer"]
@@ -133,7 +138,14 @@ class BatchScorer:
             w = None if self.w is None else self.w[idx]
             with self._evals_lock:
                 self.num_evals += P * (len(idx) / self.dataset.n)
-        if self._sharded is not None and idx is None:
+        if self.options.loss_function_jit is not None:
+            # traceable full objective: preds matrix -> [P] losses, in-graph
+            # (under GSPMD-sharded X/y the objective's row reductions become
+            # global collectives automatically, so the value stays exact)
+            dev_losses = objective_loss_jit(
+                flat, X, y, w, self.opset, self.options.loss_function_jit
+            )
+        elif self._sharded is not None and idx is None:
             import jax.numpy as jnp
 
             from ..parallel.sharding import shard_population

@@ -214,7 +214,8 @@ def _neldermead_single(
     return verts[best], fvals[best]
 
 
-def remat_tree_loss(opset, loss_elem, X, y, w, has_w, complex_n=None):
+def remat_tree_loss(opset, loss_elem, X, y, w, has_w, complex_n=None,
+                    objective=None):
     """Interpreter loss closure with rematerialization: recompute the forward
     sweep in the backward pass instead of saving per-branch residuals —
     trades ~2x FLOPs for ~n_ops x less live memory, which is what bounds the
@@ -225,8 +226,19 @@ def remat_tree_loss(opset, loss_elem, X, y, w, has_w, complex_n=None):
     ``complex_n``: optimize complex constants through a REAL 2N view
     (v = [real; imag]) so the BFGS/Nelder-Mead inner products stay valid —
     the reference drives Optim's BFGS for complex T the equivalent way
-    (/root/reference/src/ConstantOptimization.jl:27)."""
-    raw = _tree_loss_fn(opset, loss_elem)
+    (/root/reference/src/ConstantOptimization.jl:27).
+
+    ``objective``: JAX-traceable full objective (Options.loss_function_jit)
+    — constants are then tuned against the SAME objective the search
+    scores with, not the elementwise loss."""
+    if objective is not None:
+        def raw(val, structure, X_, y_, w_, hw_):
+            pred = _eval_one(opset, structure, val, X_)
+            return jnp.asarray(
+                objective(pred[None, :], y_, w_ if hw_ else None)
+            )[0]
+    else:
+        raw = _tree_loss_fn(opset, loss_elem)
     if complex_n is None:
         ck = jax.checkpoint(lambda v, s: raw(v, s, X, y, w, has_w))
     else:
@@ -243,11 +255,14 @@ def remat_tree_loss(opset, loss_elem, X, y, w, has_w, complex_n=None):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("opset", "loss_elem", "iters", "has_w", "algorithm", "complex_vals"),
+    static_argnames=(
+        "opset", "loss_elem", "iters", "has_w", "algorithm", "complex_vals",
+        "objective",
+    ),
 )
 def _optimize_batch(
     flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS",
-    complex_vals=False,
+    complex_vals=False, objective=None,
 ):
     """starts: [P, S, N] initial constant vectors (S = 1 + nrestarts).
     Returns best (val [P,N], loss [P]) over restarts per tree.
@@ -268,6 +283,7 @@ def _optimize_batch(
     loss_fn = remat_tree_loss(
         opset, loss_elem, X, y, w, has_w,
         complex_n=N_slots if complex_vals else None,
+        objective=objective,
     )
     structure = _Structure(flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.length)
     mask = flat.kind == KIND_CONST  # [P, N]
@@ -526,6 +542,7 @@ def optimize_constants_batched(
         has_w,
         algorithm=options.optimizer_algorithm,
         complex_vals=complex_vals,
+        objective=options.loss_function_jit,
     )
     vals = np.asarray(vals)
     fs = np.asarray(fs, dtype=np.float64)

@@ -45,10 +45,17 @@ ABLATION_r04.json on the config-3 matched-budget leg):
   ABLATION_r04.json's distribution row before quoting single-seed legs.)
 Migration draws a Poisson count per island like the reference (Bernoulli
 ablation: no measurable difference).
-Complexity = node count (the reference default); custom complexity mappings
-and custom objectives route to the host engine. Per-operator size caps and
+Complexity = node count by default; CUSTOM complexity mappings run in-jit
+too (cfg.complexity_table — _complexity_of drives score parsimony,
+curmaxsize validation, mutation conditioning, the frequency histogram,
+tournament parsimony, frontier slots, and migration rescore). Traceable
+custom objectives (Options.loss_function_jit) run in-graph via the score
+closure; only host-callable per-tree loss_function routes to the host
+engine. Per-operator size caps and
 nested-operator constraints ARE enforced in-jit (_constraints_ok), and
-minibatching runs in-engine (cfg.batching + full-data finalize).
+minibatching runs in-engine (cfg.batching + full-data finalize). Recorder
+mode (cfg.record_events) makes every program additionally return event
+logs for host-side lineage replay (models/device_recorder.py).
 """
 
 from __future__ import annotations
@@ -183,6 +190,25 @@ class EvoConfig:
     bin_dim_code: tuple = ()
     dim_penalty: float = 1000.0
     allow_wildcards: bool = True
+    # recorder mode (reference: RecordType lineage tracing, mutations +
+    # deaths + tuning, /root/reference/src/Mutate.jl:126-341 +
+    # SearchUtils.jl:377-393): every engine program additionally RETURNS a
+    # per-event log (chosen mutation kind, tournament winner, replaced slot,
+    # accept flag, candidate tree arrays, and migration replace/src/pool
+    # rows) that the host replays into Recorder entries with true
+    # parent/child trees (models/device_recorder.py). Requires
+    # crossover_probability=0 (host-recorder parity; Options enforces it)
+    # and mutation_attempts=1.
+    record_events: bool = False
+    # custom complexity mapping (reference: ComplexityMapping,
+    # /root/reference/src/OptionsStruct.jl:21-113 + Complexity.jl:17-50):
+    # (bin_costs[n_binary], una_costs[n_unary], const_cost,
+    # var_costs[nfeatures]) as static tuples built by build_evo_config from
+    # Options.complexity_of_*; None -> complexity = node count (length).
+    # Every complexity consumer (score parsimony, curmaxsize/validate,
+    # frequency histogram, tournament parsimony, best-seen frontier indexing,
+    # migration rescore) routes through _complexity_of/complexity_batch.
+    complexity_table: tuple | None = None
 
 
 class EvoState(NamedTuple):
@@ -257,7 +283,18 @@ def init_state(
     val = r(flat_arrays.val, vdt)
     length = jnp.asarray(np.asarray(flat_arrays.length), jnp.int32).reshape(I, P)
     loss = jnp.asarray(np.asarray(losses), vdt).reshape(I, P)
-    comp = length.astype(vdt)
+    if cfg.complexity_table is None:
+        comp = length.astype(vdt)
+    else:
+        comp = complexity_batch(
+            Tree(
+                kind.reshape(I * P, N), op.reshape(I * P, N),
+                lhs.reshape(I * P, N), rhs.reshape(I * P, N),
+                feat.reshape(I * P, N), val.reshape(I * P, N),
+                length.reshape(I * P),
+            ),
+            cfg,
+        ).reshape(I, P).astype(vdt)
     score = _score_of(loss, comp, cfg)
     freq = (
         jnp.asarray(freq_init, jnp.float32)
@@ -565,10 +602,11 @@ def _condition_weights(tree: Tree, cfg: EvoConfig, curmaxsize) -> jax.Array:
     """Zero out illegal mutations for this tree's context
     (/root/reference/src/Mutate.jl:34-76). Returns [8] weights."""
     w = jnp.asarray(cfg.mutation_weights, jnp.float32)
-    n = tree.length
     n_const = jnp.sum(tree.kind == KIND_CONST)
     n_ops = jnp.sum(tree.kind >= KIND_UNARY)
-    at_max = n >= curmaxsize
+    # growth conditions on MAPPED complexity vs curmaxsize (the reference
+    # conditions check_constraints complexity, /root/reference/src/Mutate.jl:34-76)
+    at_max = _complexity_of(tree, cfg) >= curmaxsize
     # leaf-only tree: no operator mutation / swap / delete
     no_ops = n_ops == 0
     w = w.at[M_OPERATOR].set(jnp.where(no_ops, 0.0, w[M_OPERATOR]))
@@ -697,6 +735,54 @@ def _constraints_ok(tree: Tree, cfg: EvoConfig) -> jax.Array:
                 )
                 ok &= ~jnp.any(is_outer & (child_nest > maxn))
     return ok
+
+
+def _complexity_of(tree: Tree, cfg: EvoConfig) -> jax.Array:
+    """Mapped complexity of ONE tree, int32 (reference: compute_complexity,
+    /root/reference/src/Complexity.jl:17-50 — rounded sum of per-node costs).
+    Static identity (node count) when no custom mapping is configured."""
+    if cfg.complexity_table is None:
+        return tree.length
+    bin_c, una_c, const_c, var_c = cfg.complexity_table
+    bc = jnp.asarray(bin_c or (1.0,), jnp.float32)
+    uc = jnp.asarray(una_c or (1.0,), jnp.float32)
+    vc = jnp.asarray(var_c or (1.0,), jnp.float32)
+    live = jnp.arange(tree.n_slots) < tree.length
+    cost = jnp.where(
+        tree.kind == KIND_CONST,
+        jnp.float32(const_c),
+        jnp.where(
+            tree.kind == KIND_VAR,
+            vc[jnp.clip(tree.feat, 0, vc.shape[0] - 1)],
+            jnp.where(
+                tree.kind == KIND_UNARY,
+                uc[jnp.clip(tree.op, 0, uc.shape[0] - 1)],
+                bc[jnp.clip(tree.op, 0, bc.shape[0] - 1)],
+            ),
+        ),
+    )
+    return jnp.round(jnp.sum(jnp.where(live, cost, 0.0))).astype(jnp.int32)
+
+
+def complexity_batch(batch: Tree, cfg: EvoConfig) -> jax.Array:
+    """[B] mapped complexities for a [B, N] tree batch (see _complexity_of)."""
+    if cfg.complexity_table is None:
+        return batch.length
+    return jax.vmap(lambda t: _complexity_of(t, cfg))(batch)
+
+
+def _complexity_members(state: EvoState, cfg: EvoConfig) -> jax.Array:
+    """[I, P] mapped complexities of the population state."""
+    if cfg.complexity_table is None:
+        return state.length
+    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    flat = Tree(
+        state.kind.reshape(I * P, N), state.op.reshape(I * P, N),
+        state.lhs.reshape(I * P, N), state.rhs.reshape(I * P, N),
+        state.feat.reshape(I * P, N), state.val.reshape(I * P, N),
+        state.length.reshape(I * P),
+    )
+    return complexity_batch(flat, cfg).reshape(I, P)
 
 
 _DIM_TOL = 1e-4  # SI-exponent equality tolerance (1/3 etc. live in f32)
@@ -830,7 +916,8 @@ dim_penalty_batch_jit = functools.partial(jax.jit, static_argnames=("cfg",))(
 
 
 def merge_best_seen(
-    state: EvoState, cfg: EvoConfig, losses, valid, fields, lengths, axis=None
+    state: EvoState, cfg: EvoConfig, losses, valid, fields, lengths, axis=None,
+    comps=None,
 ) -> EvoState:
     """Fold a batch of scored trees into the best-seen frontier (the per-size
     mini hall of fame, /root/reference/src/SingleIteration.jl:64-100).
@@ -844,7 +931,9 @@ def merge_best_seen(
     global min per size (pmin), then the lowest-indexed winning shard
     broadcasts its tree via a masked psum, keeping bs_* replicated."""
     S1 = cfg.maxsize + 1
-    sizes = jnp.clip(lengths, 0, cfg.maxsize)
+    # frontier slots are indexed by MAPPED complexity when a custom mapping
+    # is configured (``comps``); node count otherwise
+    sizes = jnp.clip(lengths if comps is None else comps, 0, cfg.maxsize)
     size_mask = sizes[None, :] == jnp.arange(S1, dtype=sizes.dtype)[:, None]
     cand_loss = jnp.where(size_mask & valid[None, :], losses[None, :], jnp.inf)
     best_idx = jnp.argmin(cand_loss, axis=1)  # [S1]
@@ -910,12 +999,13 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
     )
 
     score_r = jnp.repeat(state.score, E, axis=0)  # [L, P], lane l -> island l//E
-    length_r = jnp.repeat(state.length, E, axis=0)
+    comp_members = _complexity_members(state, cfg)  # [I, P] (== length sans mapping)
+    comp_r = jnp.repeat(comp_members, E, axis=0)
     win1 = jax.vmap(lambda k, s, l: _tournament(k, s, l, state.freq, cfg))(
-        jax.random.split(k_t1, L), score_r, length_r
+        jax.random.split(k_t1, L), score_r, comp_r
     )
     win2 = jax.vmap(lambda k, s, l: _tournament(k, s, l, state.freq, cfg))(
-        jax.random.split(k_t2, L), score_r, length_r
+        jax.random.split(k_t2, L), score_r, comp_r
     )
 
     isl = jnp.repeat(jnp.arange(I, dtype=jnp.int32), E)  # island of each lane
@@ -948,10 +1038,11 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
             lambda k, t, m, sz: _apply_mutation(
                 k, t, m, cfg, curmaxsize, temperature, sz
             )
-        )(jax.random.split(km, L), parent1, kinds_a, sizes1)
+        )(jax.random.split(km, L), parent1, kinds_a, sizes1), kinds_a
 
+    mut_kinds = None
     if cfg.mutation_attempts <= 1:
-        mutated = _mutate_once(k_kind, k_mut)
+        mutated, mut_kinds = _mutate_once(k_kind, k_mut)
     else:
         # bounded retries: re-draw kind + mutation for lanes whose earlier
         # attempts produced an invalid candidate — the in-jit analogue of the
@@ -960,8 +1051,10 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
         # the program; opt-in via Options.device_mutation_attempts.
         def _valid(c):
             depth = jax.vmap(tree_depth)(c)
-            ok = (c.length <= jnp.minimum(curmaxsize, N)) & (
-                depth <= cfg.maxdepth
+            ok = (
+                (complexity_batch(c, cfg) <= curmaxsize)
+                & (c.length <= N)
+                & (depth <= cfg.maxdepth)
             )
             if _has_op_constraints(cfg) or cfg.nested_constraints:
                 ok &= jax.vmap(lambda t: _constraints_ok(t, cfg))(c)
@@ -970,7 +1063,7 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
         mutated = parent1
         mut_ok = jnp.zeros((L,), bool)
         for attempt in range(cfg.mutation_attempts):
-            mutated_a = _mutate_once(
+            mutated_a, _ = _mutate_once(
                 jax.random.fold_in(k_kind, attempt),
                 jax.random.fold_in(k_mut, attempt),
             )
@@ -1011,11 +1104,16 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
     )
     cand2 = pick(xo2, leaf_stub, do_xover)
 
-    # validity: complexity (= node count) and depth caps; one attempt, invalid
-    # falls back to the parent (skip_mutation_failures semantics)
+    # validity: mapped complexity vs curmaxsize, structural slot fit, and
+    # depth caps; one attempt, invalid falls back to the parent
+    # (skip_mutation_failures semantics)
     def validate(c, parent):
         depth = jax.vmap(tree_depth)(c)
-        ok = (c.length <= jnp.minimum(curmaxsize, N)) & (depth <= cfg.maxdepth)
+        ok = (
+            (complexity_batch(c, cfg) <= curmaxsize)
+            & (c.length <= N)
+            & (depth <= cfg.maxdepth)
+        )
         if _has_op_constraints(cfg) or cfg.nested_constraints:
             ok &= jax.vmap(lambda t: _constraints_ok(t, cfg))(c)
         out = pick(c, parent, ok)
@@ -1041,14 +1139,16 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
     # the frontier merge, like the reference's eval_loss
     losses = losses + dim_penalty_batch(batch, cfg)
     loss1, loss2 = losses[:L], losses[L:]
-    score1 = _score_of(loss1, cand1.length.astype(jnp.float32), cfg, data.norm)
-    score2 = _score_of(loss2, cand2.length.astype(jnp.float32), cfg, data.norm)
+    comp1 = complexity_batch(cand1, cfg)  # [L] (== cand1.length sans mapping)
+    comp2 = complexity_batch(cand2, cfg)
+    score1 = _score_of(loss1, comp1.astype(jnp.float32), cfg, data.norm)
+    score2 = _score_of(loss2, comp2.astype(jnp.float32), cfg, data.norm)
 
     # --- Metropolis accept (mutation path only; crossover children are
     # accepted whenever valid+finite, /root/reference/src/Mutate.jl:361-429) --
     fnorm = state.freq / jnp.maximum(jnp.sum(state.freq), 1e-30)
-    sz_old = jnp.clip(state.length[isl, win1], 0, cfg.maxsize)
-    sz_new = jnp.clip(cand1.length, 0, cfg.maxsize)
+    sz_old = jnp.clip(comp_members[isl, win1], 0, cfg.maxsize)
+    sz_new = jnp.clip(comp1, 0, cfg.maxsize)
     prob = jnp.ones((L,), jnp.float32)
     if cfg.annealing:
         delta = score1 - pscore1
@@ -1113,10 +1213,12 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
     st = insert(st, slot2, baby2, bloss2, bscore2, do_xover)
 
     # --- frequency histogram (accepted inserts); cross-shard: psum the delta -
-    fd = jnp.zeros_like(st.freq).at[jnp.clip(baby1.length, 0, cfg.maxsize)].add(
+    comp_b1 = jnp.where(accept1, comp1, comp_members[isl, win1])
+    comp_b2 = jnp.where(accept2, comp2, comp_members[isl, win2])
+    fd = jnp.zeros_like(st.freq).at[jnp.clip(comp_b1, 0, cfg.maxsize)].add(
         jnp.where(accept1, 1.0, 0.0)
     )
-    fd = fd.at[jnp.clip(baby2.length, 0, cfg.maxsize)].add(
+    fd = fd.at[jnp.clip(comp_b2, 0, cfg.maxsize)].add(
         jnp.where(accept2, 1.0, 0.0)
     )
     if axis is not None:
@@ -1130,18 +1232,40 @@ def _event(state: EvoState, data, cfg: EvoConfig, score_fn, temperature, curmaxs
     )
     tree_fields = [batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat, batch.val]
     st = merge_best_seen(
-        st, cfg, all_loss, all_valid, tree_fields, batch.length, axis=axis
+        st, cfg, all_loss, all_valid, tree_fields, batch.length, axis=axis,
+        comps=jnp.concatenate([comp1, comp2]),
     )
 
     n_scored = (L + jnp.sum(do_xover)).astype(jnp.float32) * cfg.eval_fraction
     if axis is not None:
         n_scored = lax.psum(n_scored, axis)
-    return st._replace(
+    st = st._replace(
         freq=freq,
         key=key,
         step=st.step + 1,
         num_evals=st.num_evals + n_scored,
     )
+    if not cfg.record_events:
+        return st
+    # recorder event log: everything the host replay needs to reconstruct
+    # true parent/child lineage (models/device_recorder.py). Recorder mode
+    # is mutation-only (crossover_probability=0, Options-enforced) and
+    # single-attempt, so mut_kinds is always set.
+    ev = {
+        "kind": mut_kinds.astype(jnp.int32),  # [L] M_* index
+        "win1": win1.astype(jnp.int32),  # [L] parent slot within island
+        "slot1": slot1.astype(jnp.int32),  # [L] replaced slot
+        "accept": accept1,  # [L] bool
+        "loss": loss1,  # [L] candidate loss (batch loss under batching)
+        "score": score1,  # [L]
+        "ploss": ploss1,  # [L] parent loss at event time
+        "pscore": pscore1,  # [L]
+        "cand": (
+            cand1.kind, cand1.op, cand1.lhs, cand1.rhs, cand1.feat,
+            cand1.val, cand1.length,
+        ),  # 7-tuple [L, N] / [L]
+    }
+    return st, ev
 
 
 # ---------------------------------------------------------------------------
@@ -1188,14 +1312,64 @@ def _run_iteration_impl(
     else:
         curmaxsize = jnp.asarray(cfg.maxsize, jnp.int32)
 
-    def body(cycle, st):
+    def _temp(cycle):
         # linspace(1, 0, ncycles): the final cycle runs at exactly T=0
         # (host parity: models/single_iteration.py np.linspace(1.0, 0.0, n))
         frac = cycle.astype(jnp.float32) / max(cfg.ncycles - 1, 1)
-        temperature = 1.0 - frac if cfg.annealing else jnp.asarray(1.0)
-        return _event(st, data, cfg, score_fn, temperature, curmaxsize, axis=axis)
+        return 1.0 - frac if cfg.annealing else jnp.asarray(1.0)
 
-    state = lax.fori_loop(0, total, body, state)
+    if not cfg.record_events:
+        def body(cycle, st):
+            return _event(
+                st, data, cfg, score_fn, _temp(cycle), curmaxsize, axis=axis
+            )
+
+        state = lax.fori_loop(0, total, body, state)
+        ev_log = None
+    else:
+        # per-cycle event-log buffers, filled by dynamic index updates so the
+        # whole iteration stays ONE compiled program (readback happens once,
+        # host-side, in models/device_recorder.py)
+        vdt = jnp.dtype(cfg.val_dtype)
+        I_, P_, N_ = cfg.n_islands, cfg.pop_size, cfg.n_slots
+        L_ = I_ * min(cfg.events_per_cycle, P_)
+        C_ = cfg.ncycles
+
+        def zeros(shape, dt):
+            return jnp.zeros((C_,) + shape, dt)
+
+        log0 = {
+            "kind": zeros((L_,), jnp.int32),
+            "win1": zeros((L_,), jnp.int32),
+            "slot1": zeros((L_,), jnp.int32),
+            "accept": zeros((L_,), bool),
+            "loss": zeros((L_,), vdt),
+            "score": zeros((L_,), vdt),
+            "ploss": zeros((L_,), vdt),
+            "pscore": zeros((L_,), vdt),
+            "cand": (
+                zeros((L_, N_), jnp.int32), zeros((L_, N_), jnp.int32),
+                zeros((L_, N_), jnp.int32), zeros((L_, N_), jnp.int32),
+                zeros((L_, N_), jnp.int32), zeros((L_, N_), vdt),
+                zeros((L_,), jnp.int32),
+            ),
+        }
+
+        def body_rec(cycle, carry):
+            st, log = carry
+            st, ev = _event(
+                st, data, cfg, score_fn, _temp(cycle), curmaxsize, axis=axis
+            )
+            log = jax.tree_util.tree_map(
+                lambda buf, row: lax.dynamic_update_index_in_dim(
+                    buf, row.astype(buf.dtype), cycle, 0
+                ),
+                log,
+                ev,
+            )
+            return st, log
+
+        state, ev_log = lax.fori_loop(0, total, body_rec, (state, log0))
     state = state._replace(iteration=state.iteration + 1)
 
     # frequency-window decay (proportional-smoothing variant of move_window!,
@@ -1211,16 +1385,29 @@ def _run_iteration_impl(
     # (_finalize_impl): the reference migrates on finalized full-data scores
     # (main loop runs migrate! after optimize_and_simplify's
     # finalize_scores), and the stored losses here are still batch-noisy.
+    mig_island = mig_hof = None
     if not cfg.batching:
         if cfg.migration:
             state = _migrate(state, cfg, use_hof=False, norm=data.norm)
+            if cfg.record_events:
+                state, mig_island = state
         if cfg.hof_migration:
             state = _migrate(state, cfg, use_hof=True, norm=data.norm)
+            if cfg.record_events:
+                state, mig_hof = state
     if axis is not None:
         # re-replicate the key: every shard derives the next key from the
         # same iteration-entry key (shard streams diverged via fold_in above)
         state = state._replace(key=jax.random.fold_in(key_in, 0x5EED))
-    return state
+    if not cfg.record_events:
+        return state
+    # pytree structure is static: cfg.migration/hof_migration are static
+    log = {"events": ev_log}
+    if mig_island is not None:
+        log["mig_island"] = mig_island
+    if mig_hof is not None:
+        log["mig_hof"] = mig_hof
+    return state, log
 
 
 def _finalize_impl(
@@ -1260,11 +1447,10 @@ def _finalize_impl(
     inc = jnp.asarray(I * P, jnp.float32)
     if axis is not None:
         inc = lax.psum(inc, axis)  # per-shard I is local; count globally
+    comp_m = _complexity_members(state, cfg)
     state = state._replace(
         loss=full_loss,
-        score=_score_of(
-            full_loss, state.length.astype(jnp.float32), cfg, data.norm
-        ),
+        score=_score_of(full_loss, comp_m.astype(jnp.float32), cfg, data.norm),
         num_evals=state.num_evals + inc,
     )
     bs_len = state.bs_tree[6]
@@ -1286,14 +1472,27 @@ def _finalize_impl(
          all_members.rhs, all_members.feat, all_members.val],
         all_members.length,
         axis=axis,
+        comps=comp_m.reshape(I * P),
     )
+    mig_island = mig_hof = None
     if cfg.migration:
         state = _migrate(state, cfg, use_hof=False, norm=data.norm)
+        if cfg.record_events:
+            state, mig_island = state
     if cfg.hof_migration:
         state = _migrate(state, cfg, use_hof=True, norm=data.norm)
+        if cfg.record_events:
+            state, mig_hof = state
     if axis is not None:
         state = state._replace(key=jax.random.fold_in(key_in, 0xF17A))
-    return state
+    if not cfg.record_events:
+        return state
+    log = {}
+    if mig_island is not None:
+        log["mig_island"] = mig_island
+    if mig_hof is not None:
+        log["mig_hof"] = mig_hof
+    return state, log
 
 
 run_iteration = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
@@ -1458,12 +1657,23 @@ def _inject_pool(
         m = replace.reshape((I, P) + (1,) * (cur.ndim - 2))
         return jnp.where(m, take, cur)
 
+    out_log = (replace, src) if cfg.record_events else None
     loss = jnp.where(replace, pool_loss[src], state.loss)
-    comp = jnp.where(replace, pool_len[src], state.length).astype(jnp.float32)
+    if cfg.complexity_table is None:
+        pool_comp = pool_len
+        member_comp = state.length
+    else:
+        pool_comp = complexity_batch(
+            Tree(pool_kind, pool_op, pool_lhs, pool_rhs, pool_feat, pool_val,
+                 pool_len),
+            cfg,
+        )
+        member_comp = _complexity_members(state, cfg)
+    comp = jnp.where(replace, pool_comp[src], member_comp).astype(jnp.float32)
     score = jnp.where(
         replace, _score_of(pool_loss[src], comp, cfg, norm), state.score
     )
-    return state._replace(
+    state = state._replace(
         kind=mix(state.kind, pool_kind),
         op=mix(state.op, pool_op),
         lhs=mix(state.lhs, pool_lhs),
@@ -1476,11 +1686,17 @@ def _inject_pool(
         birth=jnp.where(replace, state.step, state.birth),
         key=key,
     )
+    if out_log is not None:
+        return state, out_log[0], out_log[1]
+    return state
 
 
-def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool, norm=None) -> EvoState:
+def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool, norm=None):
     """Replace random members with samples from the migration pool: topn per
-    island (best_sub_pop) or the best-seen frontier (hof)."""
+    island (best_sub_pop) or the best-seen frontier (hof). Under
+    cfg.record_events returns (state, migration log) — the host replay
+    assigns migrated-in copies fresh refs (documented deviation: the
+    reference's migration copies keep their source ref)."""
     if use_hof:
         pk, po, pl, pr, pf, pv, pln = state.bs_tree
         pool = (pk, po, pl, pr, pf, pv, pln,
@@ -1491,7 +1707,11 @@ def _migrate(state: EvoState, cfg: EvoConfig, use_hof: bool, norm=None) -> EvoSt
         pool = _topn_pool(state, cfg)
         pool_valid = jnp.isfinite(pool[7])
         frac = cfg.fraction_replaced
-    return _inject_pool(state, cfg, pool, pool_valid, frac, norm)
+    out = _inject_pool(state, cfg, pool, pool_valid, frac, norm)
+    if not cfg.record_events:
+        return out
+    state, replace, src = out
+    return state, {"replace": replace, "src": src, "pool": pool}
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -1514,4 +1734,8 @@ def migrate_from_pool(
     traced score normalization (ScoreData.norm) so the program is
     dataset-independent."""
     pool_valid = jnp.isfinite(pool[7]) & (pool[6] >= 1)
-    return _inject_pool(state, cfg, pool, pool_valid, frac, norm)
+    out = _inject_pool(state, cfg, pool, pool_valid, frac, norm)
+    if not cfg.record_events:
+        return out
+    state, replace, src = out
+    return state, {"replace": replace, "src": src, "pool": pool}

@@ -29,6 +29,7 @@ from .operators import OperatorSet
 __all__ = [
     "batched_loss",
     "batched_loss_jit",
+    "objective_loss_jit",
     "loss_to_score",
     "baseline_loss",
 ]
@@ -85,6 +86,30 @@ def batched_loss_jit(flat, X, y, weights, opset, loss_elem, use_pallas=False) ->
     # DEFAULT device, which breaks CPU-committed complex data on TPU hosts
     w = weights if has_weights else np.zeros((), X.dtype)
     return _batched_loss_jit(flat, X, y, w, opset, loss_elem, has_weights)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("opset", "objective", "has_weights")
+)
+def _objective_loss_jit(flat, X, y, weights, opset, objective, has_weights):
+    preds = eval_trees(flat, X, opset)
+    losses = jnp.asarray(
+        objective(preds, y, weights if has_weights else None)
+    )
+    ok = jnp.isfinite(preds).all(axis=-1)
+    return jnp.where(ok, losses, jnp.inf)
+
+
+def objective_loss_jit(flat, X, y, weights, opset, objective) -> jax.Array:
+    """Batched losses under a JAX-traceable FULL objective
+    ``objective(preds [P, R], y, weights|None) -> [P]``
+    (Options.loss_function_jit — the in-graph counterpart of the
+    reference's per-tree loss_function,
+    /root/reference/src/LossFunctions.jl:78-94). Trees with non-finite
+    predictions get inf regardless of the objective's output."""
+    has_weights = weights is not None
+    w = weights if has_weights else np.zeros((), X.dtype)
+    return _objective_loss_jit(flat, X, y, w, opset, objective, has_weights)
 
 
 def loss_to_score(

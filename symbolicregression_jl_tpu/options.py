@@ -87,6 +87,15 @@ class Options:
     unary_operators: Sequence[Any] = ()
     elementwise_loss: Any = None  # name | callable(pred, target [,weight]); default L2
     loss_function: Callable | None = None  # full-objective override (host-side)
+    # JAX-traceable full objective: (preds [B, R], y [R], weights [R]|None)
+    # -> losses [B]. The TPU-native counterpart of ``loss_function`` — it
+    # consumes the batched prediction matrix inside the compiled scoring
+    # program, so it runs on BOTH host engines and the device engine
+    # (reference full objectives that only need predictions, e.g. custom
+    # aggregates/robust estimators, express here; tree-STRUCTURE-dependent
+    # objectives need ``loss_function``). Baseline loss stays the
+    # elementwise loss of the mean predictor, as with ``loss_function``.
+    loss_function_jit: Callable | None = None
 
     # -- complexity / constraints -------------------------------------------
     maxsize: int = 20
@@ -225,10 +234,18 @@ class Options:
                 self.loss = L2ComplexDistLoss
         if self.maxdepth is None:
             self.maxdepth = self.maxsize
+        if self.loss_function is not None and self.loss_function_jit is not None:
+            raise ValueError(
+                "loss_function and loss_function_jit are mutually exclusive: "
+                "the first is a host-side per-tree objective, the second a "
+                "JAX-traceable batched-predictions objective"
+            )
         if self.should_simplify is None:
             # Reference disables auto-simplify when a full custom objective is
             # used (the objective may depend on exact tree shape); algebraic
             # rewriting would also silently break GraphNode sharing.
+            # loss_function_jit sees only PREDICTIONS, which simplify
+            # preserves, so it keeps auto-simplify on.
             self.should_simplify = self.loss_function is None and not self.graph_nodes
         if self.deterministic and self.seed is None:
             self.seed = 0
@@ -367,7 +384,19 @@ def _normalize_nested(nested, opset: OperatorSet):
 
 def _complexity_mapping(o: Options):
     """Per-op/variable/constant complexities (reference: ComplexityMapping,
-    /root/reference/src/OptionsStruct.jl:21-113). None -> plain node count."""
+    /root/reference/src/OptionsStruct.jl:21-113). None -> plain node count.
+
+    Costs are quantized to the 2^-16 grid: every grid value is exactly
+    representable in float32, so the device engine's f32 per-node cost sums
+    (ops/evolve._complexity_of) and the host's f64 sums are bit-identical
+    for any tree whose total cost stays under 2^8 — host and engine then
+    round the SAME number, never disagreeing by the half-ulp that a raw
+    fractional cost (e.g. 0.1) would leave between the two accumulators.
+    Integer costs (the common case) are unchanged by the quantization."""
+
+    def q(a):
+        return np.round(np.asarray(a, np.float64) * 65536.0) / 65536.0
+
     custom = (
         o.complexity_of_operators is not None
         or o.complexity_of_constants is not None
@@ -388,8 +417,8 @@ def _complexity_mapping(o: Options):
     if var_c is None:
         var_c = 1.0
     return {
-        "binop": binop,
-        "unaop": unaop,
-        "constant": const_c,
-        "variable": np.asarray(var_c, dtype=np.float64),
+        "binop": q(binop),
+        "unaop": q(unaop),
+        "constant": float(q(const_c)),
+        "variable": q(var_c),
     }

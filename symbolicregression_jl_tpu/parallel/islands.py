@@ -54,6 +54,8 @@ def async_search_one_output(
     verbosity: int = 1,
     output_file: str | None = None,
     stdin_reader=None,
+    recorder=None,
+    out_j: int = 1,
 ):
     """Async-island counterpart of search._search_one_output (same contract)."""
     from ..search import SearchResult, _init_population, _rescore_population, get_cur_maxsize
@@ -97,7 +99,11 @@ def async_search_one_output(
 
     from ..utils.recorder import Recorder
 
-    recorder = Recorder(options)
+    # shared when a multi-output equation_search owns the (single) recorder
+    # file; private for standalone callers (see search._search_one_output)
+    own_recorder = recorder is None
+    if own_recorder:
+        recorder = Recorder(options)
     shared_stats = RunningSearchStatistics(options.maxsize)
     # independent RNG stream per island (thread-safe, reproducible spawn)
     seeds = np.random.SeedSequence(
@@ -146,7 +152,7 @@ def async_search_one_output(
         )
         if recorder.enabled:
             with lock:
-                recorder.record_population(1, i + 1, iteration, pop, options)
+                recorder.record_population(out_j, i + 1, iteration, pop, options)
         return i, pop, best_seen
 
     from ..utils.progress import ProgressReporter
@@ -247,7 +253,8 @@ def async_search_one_output(
     iteration_seconds = time.time() - start_time
     if own_stdin:
         stdin_reader.close()
-    recorder.dump()
+    if own_recorder:
+        recorder.dump()
     result = SearchResult(
         hall_of_fame=hof,
         populations=pops,

@@ -114,12 +114,19 @@ def _search_one_output(
     verbosity: int = 1,
     output_file: str | None = None,
     stdin_reader=None,
+    recorder=None,
+    out_j: int = 1,
 ) -> SearchResult:
     scorer = BatchScorer(dataset, options)
     nfeatures = dataset.n_features
     from .utils.recorder import Recorder
 
-    recorder = Recorder(options)
+    # a multi-output equation_search owns ONE shared recorder (dumped once,
+    # after every output finishes — concurrent per-output dumps to the same
+    # recorder_file would race); standalone callers get a private one
+    own_recorder = recorder is None
+    if own_recorder:
+        recorder = Recorder(options)
 
     # -- initialize (warm start re-scores saved members: reference
     #    _initialize_search!, /root/reference/src/SymbolicRegression.jl:722-795)
@@ -188,7 +195,7 @@ def _search_one_output(
         optimize_and_simplify_populations(pops, scorer, options, rng, recorder)
         if recorder.enabled:
             for i, pop in enumerate(pops):
-                recorder.record_population(1, i + 1, iteration, pop, options)
+                recorder.record_population(out_j, i + 1, iteration, pop, options)
 
         # merge halls of fame + frequency stats (head-side merge in the
         # reference main loop, /root/reference/src/SymbolicRegression.jl:916-926)
@@ -249,7 +256,8 @@ def _search_one_output(
     iteration_seconds = time.time() - start_time
     if own_stdin:
         stdin_reader.close()
-    recorder.dump()
+    if own_recorder:
+        recorder.dump()
     if output_file and options.save_to_file:
         # final write: the saved file must match the returned frontier
         save_hall_of_fame(output_file, hof, options, dataset.variable_names)
@@ -401,6 +409,14 @@ def equation_search(
     # share one sequential stream across threads)
     child_rngs = list(rng.spawn(nout)) if nout > 1 else [rng]
 
+    # ONE recorder for the whole fit, dumped once after every output returns:
+    # per-output recorders would all write options.recorder_file, and the
+    # concurrent path below would race them (the reference likewise keeps one
+    # record for the run, /root/reference/src/SearchUtils.jl:377-393)
+    from .utils.recorder import Recorder
+
+    shared_recorder = Recorder(options)
+
     def _run_one(j, dataset, reader=None, quiet=False):
         kw = dict(
             saved_state=saved[j] if saved is not None else None,
@@ -412,16 +428,19 @@ def equation_search(
             from .parallel.islands import async_search_one_output
 
             return async_search_one_output(
-                dataset, options, niterations, child_rngs[j], **kw
+                dataset, options, niterations, child_rngs[j],
+                recorder=shared_recorder, out_j=j + 1, **kw
             )
         if options.scheduler == "device":
             from .models.device_search import device_search_one_output
 
             return device_search_one_output(
-                dataset, options, niterations, child_rngs[j], **kw
+                dataset, options, niterations, child_rngs[j],
+                recorder=shared_recorder, out_j=j + 1, **kw
             )
         return _search_one_output(
-            dataset, options, niterations, child_rngs[j], **kw
+            dataset, options, niterations, child_rngs[j],
+            recorder=shared_recorder, out_j=j + 1, **kw
         )
 
     # --- concurrent multi-output (ALL schedulers): one search per host
@@ -469,6 +488,7 @@ def equation_search(
                     )
             finally:
                 reader.close()
+            shared_recorder.dump()
             return results
 
     results = []
@@ -478,4 +498,5 @@ def equation_search(
         # one watch_stream for the run, /root/reference/src/SearchUtils.jl:140-188)
         if getattr(results[-1], "stop_reason", None) == "user_quit":
             break
+    shared_recorder.dump()
     return results if multi_output else results[0]

@@ -106,10 +106,18 @@ def test_device_mode_rejects_unsupported():
     assert device_mode_supported(
         _opts(nested_constraints={"cos": {"cos": 0}})
     ) is None
-    # still bounced to the host engines
-    opts = _opts(use_recorder=True, crossover_probability=0.0)
-    with pytest.raises(ValueError, match="recorder"):
-        equation_search(X, y, options=opts, niterations=1, verbosity=0)
+    # round 5: the recorder runs ON the engine too (event-log replay,
+    # models/device_recorder.py) — except with multi-attempt mutation lanes
+    assert device_mode_supported(
+        _opts(use_recorder=True, crossover_probability=0.0)
+    ) is None
+    assert device_mode_supported(
+        _opts(
+            use_recorder=True, crossover_probability=0.0,
+            device_mutation_attempts=2,
+        )
+    ) is not None
+    # still bounced to the host engines: the host-callable full objective
     assert device_mode_supported(
         _opts(loss_function=lambda tree, ds, o: 0.0)
     ) is not None

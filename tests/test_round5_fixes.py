@@ -333,3 +333,293 @@ def test_parallel_outputs_multihost_warns(monkeypatch):
             X, Y, options=opts, niterations=1, verbosity=0
         )
     assert len(res) == 2
+
+
+def test_multioutput_recorder_is_one_valid_file(tmp_path):
+    """Code-review r5 fix: a multi-output fit owns ONE shared recorder,
+    dumped once after all outputs return — per-output recorders all wrote
+    options.recorder_file, and the concurrent path raced the dumps into
+    corrupt JSON. The file must parse and hold BOTH outputs' populations."""
+    import json
+
+    X, Y = _parallel_problem()
+    rec = tmp_path / "recorder.json"
+    opts = Options(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        populations=2, population_size=10, ncycles_per_iteration=10,
+        maxsize=8, save_to_file=False, seed=0, scheduler="lockstep",
+        use_recorder=True, recorder_file=str(rec), parallel_outputs=True,
+        crossover_probability=0.0,
+    )
+    equation_search(X, Y, options=opts, niterations=2, verbosity=0)
+    data = json.loads(rec.read_text())  # must be ONE valid JSON document
+    keys = set(data)
+    assert any(k.startswith("out1_pop") for k in keys), keys
+    assert any(k.startswith("out2_pop") for k in keys), keys
+    assert "mutations" in keys
+
+
+def test_device_engine_honors_neldermead():
+    """Code-review r5 fix: scheduler='device' with
+    optimizer_algorithm='NelderMead' must run Nelder-Mead (derivative-free),
+    not silently swap in BFGS. Smoke: the search runs and the frontier is
+    finite; wiring: _make_const_opt_fn selects _neldermead_single."""
+    from symbolicregression_jl_tpu.models import device_search as ds
+    from symbolicregression_jl_tpu.ops import constant_opt as co
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (1.5 * X[0] + np.cos(X[1])).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        populations=2, population_size=12, ncycles_per_iteration=15,
+        maxsize=8, save_to_file=False, seed=0, scheduler="device",
+        optimizer_algorithm="NelderMead",
+    )
+    res = equation_search(X, y, options=opts, niterations=2, verbosity=0)
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+    # direct wiring check: the selected single-tree optimizer is Nelder-Mead
+    import inspect
+
+    src = inspect.getsource(ds._make_const_opt_fn)
+    assert "_neldermead_single" in src and "optimizer_algorithm" in src
+    assert co._neldermead_single is not None
+
+
+# -- custom complexity mapping in the device engine (exclusion removed) ------
+
+def _mapping_options(**kw):
+    kw.setdefault("maxsize", 20)
+    return Options(
+        binary_operators=["+", "*"], unary_operators=["cos", "exp"],
+        complexity_of_operators={"cos": 3, "exp": 5, "*": 2},
+        complexity_of_constants=2, complexity_of_variables=1,
+        save_to_file=False, **kw,
+    )
+
+
+def test_engine_complexity_matches_host_oracle():
+    """ops/evolve.complexity_batch must equal the host compute_complexity
+    (reference: Complexity.jl:17-50) for every random tree under a custom
+    per-operator/constant/variable mapping."""
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.complexity import compute_complexity
+    from symbolicregression_jl_tpu.models.device_search import build_evo_config
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.evolve import complexity_batch
+    from symbolicregression_jl_tpu.ops.treeops import Tree
+
+    opts = _mapping_options()
+    rng = np.random.default_rng(0)
+    trees = Population.random_trees(120, opts, 3, rng)
+    flat = flatten_trees(trees, opts.max_nodes)
+    cfg = build_evo_config(
+        opts, n_features=3, baseline_loss=1.0, use_baseline=True, niterations=1
+    )
+    assert cfg.complexity_table is not None
+    batch = Tree(*(jnp.asarray(np.asarray(a)) for a in flat))
+    got = np.asarray(complexity_batch(batch, cfg))
+    want = np.asarray([compute_complexity(t, opts) for t in trees])
+    np.testing.assert_array_equal(got, want)
+
+    # FRACTIONAL costs: the mapping is quantized to the 2^-16 grid at build
+    # time, so the engine's f32 sums and the host's f64 sums round to the
+    # same integer (code-review r5 finding: raw 0.1-style costs could
+    # half-ulp-disagree across the two accumulators)
+    opts_f = Options(
+        binary_operators=["+", "*"], unary_operators=["cos", "exp"],
+        complexity_of_operators={"cos": 0.3, "exp": 1.7, "*": 0.1},
+        complexity_of_constants=0.5, complexity_of_variables=0.9,
+        maxsize=20, save_to_file=False,
+    )
+    cfg_f = build_evo_config(
+        opts_f, n_features=3, baseline_loss=1.0, use_baseline=True,
+        niterations=1,
+    )
+    got_f = np.asarray(complexity_batch(batch, cfg_f))
+    want_f = np.asarray([compute_complexity(t, opts_f) for t in trees])
+    np.testing.assert_array_equal(got_f, want_f)
+
+
+def test_device_search_with_complexity_mapping():
+    """End-to-end: scheduler='device' honors Options.complexity_of_* — the
+    exclusion is gone, the frontier's PopMember complexities equal the host
+    mapping, and every member respects maxsize in MAPPED units."""
+    from symbolicregression_jl_tpu.complexity import compute_complexity
+    from symbolicregression_jl_tpu.models.device_search import (
+        device_mode_supported,
+    )
+
+    opts = _mapping_options(
+        populations=2, population_size=16, ncycles_per_iteration=20,
+        maxsize=12, seed=0, scheduler="device",
+    )
+    assert device_mode_supported(opts) is None
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2, 80)).astype(np.float32)
+    y = (np.cos(X[0]) + 0.5 * X[1]).astype(np.float32)
+    res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+    for m in res.pareto_frontier:
+        c = compute_complexity(m.tree, opts)
+        assert m.get_complexity(opts) == c
+        assert c <= opts.maxsize
+
+
+# -- JAX-traceable full objective (Options.loss_function_jit) ----------------
+
+def _mae_objective(preds, y, weights):
+    import jax.numpy as jnp
+
+    err = jnp.abs(preds - y[None, :])
+    if weights is not None:
+        return jnp.sum(err * weights[None, :], axis=-1) / jnp.sum(weights)
+    return jnp.mean(err, axis=-1)
+
+
+def test_loss_function_jit_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Options(
+            loss_function=lambda t, d, o: 0.0,
+            loss_function_jit=_mae_objective,
+            save_to_file=False,
+        )
+
+
+@pytest.mark.parametrize("scheduler", ["lockstep", "device"])
+def test_loss_function_jit_drives_search(scheduler):
+    """The traceable full objective scores the search on BOTH engines: the
+    frontier's reported losses equal the objective evaluated host-side on
+    the decoded trees (MAE here, vs the default L2 it replaces)."""
+    from symbolicregression_jl_tpu.models.device_search import (
+        device_mode_supported,
+    )
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(2, 90)).astype(np.float32)
+    y = (2.0 * X[0] + np.cos(X[1])).astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        loss_function_jit=_mae_objective,
+        populations=2, population_size=14, ncycles_per_iteration=20,
+        maxsize=10, seed=0, scheduler=scheduler, save_to_file=False,
+    )
+    assert device_mode_supported(opts) is None
+    res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+    for m in res.pareto_frontier:
+        pred = m.tree.eval_np(X, opts.operators)
+        want = float(np.mean(np.abs(pred - y)))
+        assert np.isfinite(want)
+        np.testing.assert_allclose(m.loss, want, rtol=2e-4)
+
+
+# -- recorder on the device engine (event-log replay) ------------------------
+
+def test_device_recorder_end_to_end(tmp_path):
+    """scheduler='device' + use_recorder: the engine's event logs replay
+    into one valid recorder file with mutation lineage (true parent/child
+    trees), deaths, tuning events, and per-iteration population snapshots."""
+    import json
+
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(2, 60)).astype(np.float32)
+    y = (X[0] * X[0] + np.cos(X[1])).astype(np.float32)
+    rec = tmp_path / "device_rec.json"
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        populations=2, population_size=12, ncycles_per_iteration=6,
+        maxsize=10, seed=0, scheduler="device", save_to_file=False,
+        use_recorder=True, recorder_file=str(rec),
+        crossover_probability=0.0,
+    )
+    from symbolicregression_jl_tpu.models.device_search import (
+        device_mode_supported,
+    )
+
+    assert device_mode_supported(opts) is None
+    equation_search(X, y, options=opts, niterations=2, verbosity=0)
+    data = json.loads(rec.read_text())
+    muts = data["mutations"]
+    events = [e for m in muts.values() for e in m["events"]]
+    assert any(e["type"] == "mutate" for e in events)
+    assert any(e["type"] == "death" for e in events)
+    # every recorded member entry carries a rendered tree
+    assert all(isinstance(m["tree"], str) and m["tree"] for m in muts.values())
+    # per-iteration population snapshots for both islands, both iterations
+    for i in (1, 2):
+        key = f"out1_pop{i}"
+        assert key in data, sorted(data)
+        assert {"iteration1", "iteration2"} <= set(data[key])
+    # mutate events reference a child that exists in the record
+    child_refs = {
+        str(e["child"]) for e in events if e["type"] == "mutate"
+    }
+    assert child_refs & set(muts), "no mutate event child found in record"
+
+
+def test_device_recorder_mirror_matches_engine_state():
+    """The replay's tree mirror must track the engine exactly: after
+    replaying one recorded iteration, the mirror's trees render identically
+    to the decoded engine state (strong fidelity check for the event log)."""
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.device_search import (
+        _make_score_fn, build_evo_config,
+    )
+    from symbolicregression_jl_tpu.models.device_recorder import (
+        EngineLineageReplay,
+    )
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.evolve import init_state, run_iteration
+    from symbolicregression_jl_tpu.ops.flat import FlatTrees, unflatten_tree
+    from symbolicregression_jl_tpu.utils.recorder import Recorder
+
+    opts = Options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        populations=2, population_size=10, ncycles_per_iteration=5,
+        maxsize=10, seed=0, scheduler="device", save_to_file=False,
+        use_recorder=True, crossover_probability=0.0,
+    )
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2, 40)).astype(np.float32)
+    y = (X[0] + X[1]).astype(np.float32)
+    I, P = 2, 10
+    cfg = build_evo_config(
+        opts, n_features=2, baseline_loss=1.0, use_baseline=True,
+        niterations=1, n_rows=X.shape[1],
+    )
+    assert cfg.record_events
+    trees = Population.random_trees(I * P, opts, 2, rng)
+    flat = flatten_trees(trees, opts.max_nodes)
+    score_fn, data = _make_score_fn(X, y, None, opts, use_pallas=False)
+    state = init_state(flat, np.zeros(I * P), cfg, seed=11)
+    rec = Recorder(opts, enabled=True)
+    state0 = tuple(
+        np.asarray(a).reshape((I, P) + np.shape(a)[1:])
+        for a in (flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat,
+                  np.asarray(flat.val, np.float32), flat.length)
+    )
+    replay = EngineLineageReplay(state0, opts, rec, out_j=1)
+    import jax
+
+    state, log = run_iteration(state, data, cfg, score_fn)
+    replay.consume_iteration(jax.tree_util.tree_map(np.asarray, log))
+    # decode the real engine state and compare tree-by-tree
+    kind = np.asarray(state.kind); op = np.asarray(state.op)
+    lhs = np.asarray(state.lhs); rhs = np.asarray(state.rhs)
+    feat = np.asarray(state.feat); val = np.asarray(state.val)
+    length = np.asarray(state.length)
+    mismatches = 0
+    for i in range(I):
+        flat_i = FlatTrees(
+            kind[i], op[i], lhs[i], rhs[i], feat[i], val[i], length[i]
+        )
+        for p in range(P):
+            want = unflatten_tree(flat_i, p).string_tree(opts.operators)
+            got = replay.trees[i, p].string_tree(opts.operators)
+            mismatches += want != got
+    assert mismatches == 0, f"{mismatches} mirror/state tree mismatches"
