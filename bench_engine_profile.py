@@ -1,0 +1,195 @@
+"""Stage-level profile of one device-engine iteration (round 6).
+
+Produces the ENGINE_PROFILE artifact VERDICT r05 asked for: where does a
+config-3 engine iteration spend its time once the scoring kernel itself is
+26x the reference? Three measurements:
+
+1. ``Options.profile=True`` run — per-stage walls (evolve / const_opt /
+   finalize / readback_pack / readback_d2h / decode_hof / simplify /
+   migrate + unattributed ``other``) with block_until_ready fencing, from
+   ``SearchResult.engine_profile``.
+2. ``scoring_cost_probe`` — the fused evolve program cannot be segmented by
+   host timers, so the probe times the program's exact per-cycle scoring
+   call standalone and scales by ncycles (ROOFLINE-style estimate of the
+   scoring share inside the ``evolve`` stage).
+3. Throughput A/B with profiling OFF — the pipelined (async_readback) loop
+   vs the synchronous loop, evals/s and best_loss, plus a microbench of the
+   disabled profiler's per-stage cost (the <2% overhead claim).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_engine_profile.py --niterations 4
+    python bench_engine_profile.py --full-config3 --out ENGINE_PROFILE_r06.json
+
+On non-TPU hosts the default config is a scaled config-3 (same operator set
+and maxsize, smaller population grid) and the artifact is labeled with the
+platform — CPU numbers bound structure, not TPU speed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _engine_options(kwargs, **overrides):
+    from symbolicregression_jl_tpu import Options
+
+    base = dict(save_to_file=False, seed=0, scheduler="device")
+    base.update(kwargs)
+    base.update(overrides)
+    return Options(**base)
+
+
+def _config(full_config3: bool):
+    from bench_problems import config3_problem
+
+    X, y, kwargs = config3_problem()
+    if not full_config3:
+        # scaled config-3: identical operators/maxsize, 1/25th the events per
+        # iteration — the stage STRUCTURE is what the profile measures
+        kwargs = dict(
+            kwargs, populations=20, population_size=50,
+            ncycles_per_iteration=110,
+        )
+    return X, y, kwargs
+
+
+def _run_search(X, y, kwargs, niterations, **overrides):
+    from symbolicregression_jl_tpu import equation_search
+
+    options = _engine_options(kwargs, **overrides)
+    res = equation_search(X, y, options=options, niterations=niterations, verbosity=0)
+    return res, options
+
+
+def _profiler_overhead_microbench(iteration_mean_ms: float):
+    """Cost of the DISABLED profiler per engine iteration: the engine makes
+    ~10 stage/fence calls per iteration; time them against NULL_PROFILER."""
+    from symbolicregression_jl_tpu.utils.profiling import NULL_PROFILER
+
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with NULL_PROFILER.stage("x"):
+            pass
+    per_call_ns = (time.perf_counter() - t0) / reps * 1e9
+    calls_per_iteration = 10
+    per_iter_ms = per_call_ns * calls_per_iteration / 1e6
+    return {
+        "null_stage_call_ns": round(per_call_ns, 1),
+        "stage_calls_per_iteration": calls_per_iteration,
+        "overhead_ms_per_iteration": round(per_iter_ms, 6),
+        "overhead_fraction_of_iteration": (
+            round(per_iter_ms / iteration_mean_ms, 9)
+            if iteration_mean_ms > 0 else None
+        ),
+    }
+
+
+def _scoring_probe(X, y, options, niterations):
+    """ROOFLINE-style estimate of the scoring share inside the fused evolve
+    program (see ops.evolve.scoring_cost_probe)."""
+    import jax
+
+    from symbolicregression_jl_tpu.models.device_search import (
+        _make_score_fn, build_evo_config,
+    )
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops.evolve import init_state, scoring_cost_probe
+    from symbolicregression_jl_tpu.ops.flat import flatten_trees
+
+    use_pallas = jax.devices()[0].platform == "tpu"
+    cfg = build_evo_config(
+        options, X.shape[0], baseline_loss=float(np.var(y)),
+        use_baseline=True, niterations=niterations,
+    )
+    score_fn, data = _make_score_fn(X, y, None, options, use_pallas)
+    rng = np.random.default_rng(0)
+    trees = Population.random_trees(
+        cfg.n_islands * cfg.pop_size, options, X.shape[0], rng
+    )
+    flat = flatten_trees(trees, cfg.n_slots)
+    state = init_state(flat, np.zeros(len(trees)), cfg, 0)
+    ms, rows = scoring_cost_probe(state, data, cfg, score_fn)
+    return {"scoring_ms_per_iteration_est": round(ms, 3), "probe_batch_rows": rows}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--niterations", type=int, default=4)
+    ap.add_argument("--profile-iters", type=int, default=None,
+                    help="iterations for the profiled run (default: --niterations)")
+    ap.add_argument("--full-config3", action="store_true",
+                    help="unscaled config-3 (use on TPU hosts)")
+    ap.add_argument("--out", default=None, help="write the artifact JSON here")
+    args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    X, y, kwargs = _config(args.full_config3)
+    n_prof = args.profile_iters or args.niterations
+
+    # 1) profiled run (forces the synchronous loop; fences every stage)
+    res_p, options = _run_search(X, y, kwargs, n_prof, profile=True)
+    profile = res_p.engine_profile
+
+    # 2) scoring share inside the fused evolve program
+    probe = _scoring_probe(X, y, options, args.niterations)
+    evolve_ms = profile["stages"].get("evolve", {}).get("mean_ms", 0.0)
+    if evolve_ms > 0:
+        probe["fraction_of_evolve_stage"] = round(
+            probe["scoring_ms_per_iteration_est"] / evolve_ms, 4
+        )
+
+    # 3) throughput A/B, profiling off (async is the production default)
+    res_a, _ = _run_search(X, y, kwargs, args.niterations, async_readback=True)
+    res_s, _ = _run_search(X, y, kwargs, args.niterations, async_readback=False)
+
+    def _tp(res):
+        return {
+            "evals": float(res.num_evals),
+            "loop_s": round(res.iteration_seconds, 4),
+            "evals_per_sec_loop": round(res.num_evals / res.iteration_seconds, 1),
+            "best_loss": float(min(m.loss for m in res.pareto_frontier)),
+        }
+
+    tp_async, tp_sync = _tp(res_a), _tp(res_s)
+    out = {
+        "artifact": "ENGINE_PROFILE",
+        "platform": platform,
+        "device_count": jax.device_count(),
+        "config": {
+            "name": "config3" if args.full_config3 else "config3_scaled",
+            "rows": int(X.shape[1]), "features": int(X.shape[0]),
+            **{k: v for k, v in kwargs.items()
+               if not callable(v) and k != "loss_function_jit"},
+            "niterations": args.niterations,
+        },
+        "profiled": profile,
+        "scoring_probe": probe,
+        "throughput": {
+            "async_on": tp_async,
+            "async_off": tp_sync,
+            "speedup_async_over_sync": round(
+                tp_async["evals_per_sec_loop"]
+                / max(tp_sync["evals_per_sec_loop"], 1e-9), 4
+            ),
+        },
+        "profiler_overhead_when_disabled": _profiler_overhead_microbench(
+            profile.get("iteration_mean_ms", 0.0)
+        ),
+    }
+    text = json.dumps(out, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

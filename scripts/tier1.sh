@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Tier-1 verification: the fast CPU test suite (ROADMAP.md "Tier-1 verify").
+# Runs the whole tests/ tree on the CPU backend, excluding slow-marked tests,
+# and prints a DOTS_PASSED count parsed from the pytest progress lines.
+#
+# Usage: scripts/tier1.sh [extra pytest args...]
+set -o pipefail
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+TIMEOUT="${TIER1_TIMEOUT:-870}"
+rm -f "$LOG"
+
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"

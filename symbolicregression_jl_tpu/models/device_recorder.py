@@ -16,7 +16,14 @@ Documented deviations from the host engines' records:
 - migrated-in copies get FRESH refs (the reference's migration copies keep
   their source member's ref) — migration appears as death + unrelated birth;
 - rejected events insert a parent copy under a fresh ref (host path keeps the
-  parent object alive in place).
+  parent object alive in place);
+- with ``Options.batching`` the recorded per-event losses are MINIBATCH
+  losses (each event scores a fresh with-replacement row subset, like the
+  reference's ``score_func_batched`` accept draw), and the iteration-boundary
+  finalize's exact full-data rescore is NOT replayed into the mirror — so a
+  member's recorded loss can differ from the same tree's loss in the hall of
+  fame / CSV output, which always come from the finalize rescore. Mirror
+  losses are the engine's accept-time evidence, not the reporting losses.
 """
 
 from __future__ import annotations

@@ -1158,12 +1158,13 @@ def _shard_const_opt(mesh, impl, data_specs=None):
     import jax
 
     from ..ops.evolve import evo_state_specs
+    from ..parallel.mesh import shard_map_compat
 
     from jax.sharding import PartitionSpec as P
 
     specs = evo_state_specs()
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             impl, mesh=mesh,
             in_specs=(specs, data_specs if data_specs is not None else P()),
             out_specs=specs,
@@ -1613,8 +1614,10 @@ def device_search_one_output(
         # inside score_fn yields replicated exact losses
         from jax.sharding import PartitionSpec as _PS
 
+        from ..parallel.mesh import shard_map_compat
+
         _sc_sharded = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 lambda b, d: score_fn(b, d),
                 mesh=mesh,
                 in_specs=(_PS(), data_specs),
@@ -1663,12 +1666,24 @@ def device_search_one_output(
             loss0=np.asarray(state.loss), score0=np.asarray(state.score),
         )
 
+    # pipelined readback (round 6): resolved before AOT warmup so the
+    # iteration executable can be compiled with donated state buffers.
+    # Auto (None): on unless lineage replay (lockstep log consumption) or
+    # profiling (stage fences serialize the pipeline anyway) needs the
+    # synchronous path. Explicit True with either is rejected in
+    # Options.__post_init__.
+    async_rb = options.async_readback
+    if async_rb is None:
+        async_rb = replay is None and not options.profile
+    if replay is not None or options.profile:
+        async_rb = False
+
     if mesh is not None:
         from ..ops.evolve import make_sharded_iteration, shard_evo_state
 
         state = shard_evo_state(state, mesh)
         iter_fn = make_sharded_iteration(
-            mesh, cfg_local, score_fn, data_specs=data_specs
+            mesh, cfg_local, score_fn, data_specs=data_specs, donate=async_rb
         )
     else:
         iter_fn = None
@@ -1727,13 +1742,17 @@ def device_search_one_output(
         k_iter = (
             "iter", cfg_local, score_fn,
             (pop_shards, rows_shards) if mesh else 0,
+            async_rb,  # donated executables are distinct programs
         )
         run_step = _AOT_CACHE.get(k_iter)
         if run_step is None:
+            from ..ops.evolve import run_iteration_donated
+
+            base_iter = run_iteration_donated if async_rb else run_iteration
             run_step = (
                 iter_fn.lower(state, score_data).compile()
                 if iter_fn is not None
-                else run_iteration.lower(state, score_data, ecfg, score_fn).compile()
+                else base_iter.lower(state, score_data, ecfg, score_fn).compile()
             )
             _aot_cache_put(k_iter, run_step)
         copt_step = None
@@ -1743,6 +1762,10 @@ def device_search_one_output(
             k_copt = (
                 "copt", cfg_local, X.shape, w is not None,
                 options.operators, options.loss,
+                # the traceable custom objective is baked into the compiled
+                # const-opt program — omitting it here silently reused a
+                # stale objective across searches (ADVICE r05)
+                options.loss_function_jit,
                 options.optimizer_probability,
                 options.optimizer_nrestarts, options.optimizer_iterations,
                 options.optimizer_algorithm,
@@ -1797,11 +1820,13 @@ def device_search_one_output(
                 Tree(*dummy_pool[:6], dummy_pool[6])
             ).block_until_ready()
     else:
-        run_step = (
-            iter_fn
-            if iter_fn is not None
-            else lambda st, d: run_iteration(st, d, ecfg, score_fn)
-        )
+        if iter_fn is not None:
+            run_step = iter_fn
+        else:
+            from ..ops.evolve import run_iteration_donated
+
+            _iter_jit = run_iteration_donated if async_rb else run_iteration
+            run_step = lambda st, d: _iter_jit(st, d, ecfg, score_fn)  # noqa: E731
         copt_step = const_opt_fn
         fin_step = finalize_fn
         readback_step = readback_fn
@@ -1824,102 +1849,96 @@ def device_search_one_output(
     )
 
     from ..ops.evolve import extract_topn_pool, migrate_from_pool
+    from ..utils.profiling import NULL_PROFILER, StageProfiler
 
-    for it in range(niterations):
-        state = run_step(state, score_data)
-        if replay is not None:
-            state, iter_log = state
-            replay.consume_iteration(iter_log)
-        if copt_step is not None:
-            state = copt_step(state, score_data)
-            if replay is not None:
-                state, tuning_log = state
-                replay.consume_tuning(tuning_log)
-        if fin_step is not None:
-            # batching: full-data finalize AFTER the batch const-opt, so the
-            # readback below only ever sees exact losses
-            state = fin_step(state, score_data)
-            if replay is not None:
-                state, fin_log = state
-                for mk in ("mig_island", "mig_hof"):
-                    if mk in fin_log:
-                        replay.consume_migration(fin_log[mk])
-        buf = np.asarray(readback_step(state))  # the iteration's ONE readback
+    prof = StageProfiler() if options.profile else NULL_PROFILER
+    device_evals = 0.0
+    # pipelined-loop carry: iteration i-1's packed readback (single-host) /
+    # the double-buffered exchange slot (multi-host)
+    pending_rb = None
+    exchange = (
+        dist.DoubleBufferedExchange() if (multi_host and async_rb) else None
+    )
 
+    def _consume_readback(gathered, buf, it_label):
+        """Fold one iteration's packed readback — and, multi-host, the
+        allgathered exchange payload — into the hall of fame, then inject
+        the migration/simplify pools into the CURRENT device state. In the
+        pipelined loop (async_rb) the payload is one iteration old, so the
+        injected pools are one-iteration-stale — the reference's async
+        snapshot-migration semantics
+        (/root/reference/src/SymbolicRegression.jl:933-943)."""
+        nonlocal state, host_evals, device_evals
         if multi_host:
-            # --- the iteration's single cross-host exchange (DCN): this
-            # process's readback buffer + topn migration pool, allgathered.
-            # The pool readback is skipped when migration is off (options are
-            # identical on every process, so the exchange stays uniform) ---
-            pool_local = (
-                tuple(np.asarray(a) for a in extract_topn_pool(state, ecfg))
-                if options.migration
-                else ()
-            )
-            gathered = dist.all_gather_migration_pool((buf, *pool_local))
-            decoded = [
-                _decode_readback(np.asarray(gathered[0][pi]), cfg)
-                for pi in range(n_proc)
-            ]
-            device_evals = sum(d[4] for d in decoded)
-            decoded_members = []
-            for d in decoded:
-                decoded_members.extend(
-                    _bs_to_members(d[0], d[1], d[2], d[3], cfg, options)
-                )
-            # under batching the decoded frontier already carries exact
-            # full-data losses: the engine rescores bs in-graph at the
-            # iteration boundary (_run_iteration_impl finalize)
-            for m in decoded_members:
-                hof.update(m, options)
+            with prof.stage("decode_hof"):
+                decoded = [
+                    _decode_readback(np.asarray(gathered[0][pi]), cfg)
+                    for pi in range(n_proc)
+                ]
+                device_evals = sum(d[4] for d in decoded)
+                decoded_members = []
+                for d in decoded:
+                    decoded_members.extend(
+                        _bs_to_members(d[0], d[1], d[2], d[3], cfg, options)
+                    )
+                # under batching the decoded frontier already carries exact
+                # full-data losses: the engine rescores bs in-graph at the
+                # iteration boundary (_run_iteration_impl finalize)
+                for m in decoded_members:
+                    hof.update(m, options)
             # inject the now-global pools: all processes' topn members with
             # fraction_replaced, all processes' best-seen frontiers with
             # fraction_replaced_hof (reference migrate! semantics)
-            if options.migration:
-                topn_pool = tuple(
-                    jnp.asarray(g.reshape((-1,) + g.shape[2:]))
-                    for g in gathered[1:]
-                )
-                state = migrate_from_pool(
-                    state, ecfg, topn_pool, float(options.fraction_replaced),
-                    score_data.norm,
-                )
-            if options.hof_migration:
-                hof_pool = tuple(
-                    jnp.asarray(a) for a in _hof_pool_np(decoded, cfg)
-                )
-                state = migrate_from_pool(
-                    state, ecfg, hof_pool, float(options.fraction_replaced_hof),
-                    score_data.norm,
-                )
+            with prof.stage("migrate"):
+                if options.migration:
+                    topn_pool = tuple(
+                        jnp.asarray(g.reshape((-1,) + g.shape[2:]))
+                        for g in gathered[1:]
+                    )
+                    state = migrate_from_pool(
+                        state, ecfg, topn_pool,
+                        float(options.fraction_replaced), score_data.norm,
+                    )
+                if options.hof_migration:
+                    hof_pool = tuple(
+                        jnp.asarray(a) for a in _hof_pool_np(decoded, cfg)
+                    )
+                    state = migrate_from_pool(
+                        state, ecfg, hof_pool,
+                        float(options.fraction_replaced_hof), score_data.norm,
+                    )
+                prof.fence(state)
         else:
-            bs_loss, bs_exists, bs_len, fields, device_evals = _decode_readback(
-                buf, cfg
-            )
-            decoded_members = _bs_to_members(
-                bs_loss, bs_exists, bs_len, fields, cfg, options
-            )
-            # frontier losses are already full-data-exact under batching
-            # (in-graph finalize rescore) — no host-side re-evaluation
-            for m in decoded_members:
-                hof.update(m, options)
+            with prof.stage("decode_hof"):
+                (
+                    bs_loss, bs_exists, bs_len, fields, device_evals
+                ) = _decode_readback(buf, cfg)
+                decoded_members = _bs_to_members(
+                    bs_loss, bs_exists, bs_len, fields, cfg, options
+                )
+                # frontier losses are already full-data-exact under batching
+                # (in-graph finalize rescore) — no host-side re-evaluation
+                for m in decoded_members:
+                    hof.update(m, options)
 
         if do_simplify:
             # identical deterministic work on every process in multi-host
             # mode (same decoded input -> same pool -> same replicated-key
             # injection), so no extra exchange is needed
-            pool, n_scored = _simplified_frontier_pool(
-                decoded_members, options, cfg, score_call, hof
-            )
-            host_evals += n_scored
-            if pool is not None:
-                state = migrate_from_pool(
-                    state, ecfg, pool, float(options.fraction_replaced_hof),
-                    score_data.norm,
+            with prof.stage("simplify"):
+                pool, n_scored = _simplified_frontier_pool(
+                    decoded_members, options, cfg, score_call, hof
                 )
-                if replay is not None:
-                    state, mig_log = state
-                    replay.consume_migration(mig_log)
+                host_evals += n_scored
+                if pool is not None:
+                    state = migrate_from_pool(
+                        state, ecfg, pool,
+                        float(options.fraction_replaced_hof), score_data.norm,
+                    )
+                    if replay is not None:
+                        state, mig_log = state
+                        replay.consume_migration(mig_log)
+                prof.fence(state)
 
         if replay is not None:
             # authoritative per-iteration population snapshot (the recorder's
@@ -1934,11 +1953,79 @@ def device_search_one_output(
                         state.score,
                     )
                 ),
-                it + 1,
+                it_label,
             )
 
+    for it in range(niterations):
+        with prof.stage("evolve"):
+            state = run_step(state, score_data)
+            if replay is not None:
+                state, iter_log = state
+                replay.consume_iteration(iter_log)
+            prof.fence(state)
+        if copt_step is not None:
+            with prof.stage("const_opt"):
+                state = copt_step(state, score_data)
+                if replay is not None:
+                    state, tuning_log = state
+                    replay.consume_tuning(tuning_log)
+                prof.fence(state)
+        if fin_step is not None:
+            # batching: full-data finalize AFTER the batch const-opt, so the
+            # readback below only ever sees exact losses
+            with prof.stage("finalize"):
+                state = fin_step(state, score_data)
+                if replay is not None:
+                    state, fin_log = state
+                    for mk in ("mig_island", "mig_hof"):
+                        if mk in fin_log:
+                            replay.consume_migration(fin_log[mk])
+                prof.fence(state)
+        with prof.stage("readback_pack"):
+            rb = readback_step(state)  # the iteration's ONE readback
+            prof.fence(rb)
+        pool_dev = ()
+        if multi_host and options.migration:
+            # this process's topn migration pool rides the same exchange as
+            # the readback buffer; skipped when migration is off (options
+            # are identical on every process, so the exchange stays uniform)
+            with prof.stage("pool_extract"):
+                pool_dev = extract_topn_pool(state, ecfg)
+                prof.fence(pool_dev)
+
+        if async_rb:
+            # software pipeline (round 6): start the copy stream for THIS
+            # iteration's payload, then consume the PREVIOUS one while the
+            # device queue (which already holds this iteration's programs)
+            # keeps computing — the readback D2H and the multi-host gather
+            # overlap device compute instead of serializing after it
+            rb.copy_to_host_async()
+            for a in pool_dev:
+                a.copy_to_host_async()
+            if multi_host:
+                gathered = exchange.roll((rb, *pool_dev))
+                if gathered is not None:
+                    _consume_readback(gathered, None, it)
+            else:
+                prev_rb, pending_rb = pending_rb, rb
+                if prev_rb is not None:
+                    _consume_readback(None, np.asarray(prev_rb), it)
+        elif multi_host:
+            # --- the iteration's single cross-host exchange (DCN): this
+            # process's readback buffer + topn migration pool, allgathered ---
+            with prof.stage("readback_d2h"):
+                payload = tuple(np.asarray(a) for a in (rb, *pool_dev))
+            with prof.stage("exchange"):
+                gathered = dist.all_gather_migration_pool(payload)
+            _consume_readback(gathered, None, it + 1)
+        else:
+            with prof.stage("readback_d2h"):
+                buf = np.asarray(rb)
+            _consume_readback(None, buf, it + 1)
+
         # count AFTER the iteration's host-triggered rescore/simplify evals so
-        # the max_evals stop and the returned total see them immediately
+        # the max_evals stop and the returned total see them immediately (in
+        # the pipelined loop both lag one iteration, like the readback)
         num_evals = device_evals + host_evals
 
         if output_file and options.save_to_file and head:
@@ -1957,7 +2044,10 @@ def device_search_one_output(
 
         # stop decision — in multi-host mode it must be LOCKSTEP: any
         # process's local trigger (head's stdin, clock skew on timeout) is
-        # allgathered so every process breaks on the same iteration
+        # allgathered so every process breaks on the same iteration. The
+        # pipelined loop sees hof/num_evals one iteration late, so
+        # early_stop/max_evals fire one iteration later than the sync path
+        # (documented deviation; the stale window matches the migration lag).
         stop_code = 0
         if early_stop is not None and any(
             early_stop(m.loss, m.get_complexity(options))
@@ -1974,18 +2064,32 @@ def device_search_one_output(
         elif head and stdin_reader.check_for_user_quit():
             stop_code = 4
         if multi_host:
-            stop_code = int(
-                np.max(
-                    dist.all_gather_migration_pool(
-                        np.asarray([stop_code], np.int32)
+            with prof.stage("stop_sync"):
+                stop_code = int(
+                    np.max(
+                        dist.all_gather_migration_pool(
+                            np.asarray([stop_code], np.int32)
+                        )
                     )
                 )
-            )
+        prof.next_iteration()
         if stop_code:
             stop_reason = {
                 1: "early_stop", 2: "timeout", 3: "max_evals", 4: "user_quit"
             }[stop_code]
             break
+
+    if async_rb:
+        # drain the pipeline: the last iteration's readback (and exchange
+        # payload) is still in flight. Every process reaches here on the
+        # same iteration (lockstep stop), so the final gather stays uniform.
+        if multi_host:
+            gathered = exchange.flush()
+            if gathered is not None:
+                _consume_readback(gathered, None, niterations)
+        elif pending_rb is not None:
+            _consume_readback(None, np.asarray(pending_rb), niterations)
+        num_evals = device_evals + host_evals
 
     iteration_seconds = time.time() - start_time
     if own_stdin:
@@ -2081,6 +2185,10 @@ def device_search_one_output(
     # loop-only wall time (compile/warmup/setup excluded): the honest
     # denominator for end-to-end throughput (bench.py e2e_main)
     result.iteration_seconds = iteration_seconds
+    if options.profile:
+        # per-stage walls of the engine loop (utils/profiling.StageProfiler);
+        # bench_engine_profile.py turns this into ENGINE_PROFILE artifacts
+        result.engine_profile = prof.summary()
     if own_recorder:
         recorder.dump()
     return result

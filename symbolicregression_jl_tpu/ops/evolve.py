@@ -85,7 +85,9 @@ __all__ = [
     "EvoState",
     "init_state",
     "run_iteration",
+    "run_iteration_donated",
     "run_finalize",
+    "scoring_cost_probe",
     "evo_state_specs",
     "shard_evo_state",
     "make_sharded_iteration",
@@ -1499,6 +1501,15 @@ run_iteration = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
     _run_iteration_impl
 )
 
+# donated twin for the software-pipelined engine loop: the previous
+# iteration's EvoState buffers are reused in place, so the double-buffered
+# readback path doesn't hold two full population states alive. The engine
+# dispatches the packed readback of state i BEFORE the donating call for
+# state i+1, so every consumer of the donated buffers is already enqueued.
+run_iteration_donated = functools.partial(
+    jax.jit, static_argnames=("cfg", "score_fn"), donate_argnums=(0,)
+)(_run_iteration_impl)
+
 run_finalize = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
     _finalize_impl
 )
@@ -1509,7 +1520,9 @@ def make_sharded_finalize(mesh, cfg_local: EvoConfig, score_fn, data_specs=None)
     specs = evo_state_specs()
     from jax.sharding import PartitionSpec as _P
 
-    fn = jax.shard_map(
+    from ..parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         lambda st, data: _finalize_impl(st, data, cfg_local, score_fn, axis="pop"),
         mesh=mesh,
         in_specs=(specs, data_specs if data_specs is not None else _P()),
@@ -1562,7 +1575,9 @@ def shard_evo_state(state: EvoState, mesh) -> EvoState:
     return jax.tree_util.tree_unflatten(treedef, placed)
 
 
-def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn, data_specs=None):
+def make_sharded_iteration(
+    mesh, cfg_local: EvoConfig, score_fn, data_specs=None, donate=False
+):
     """Jitted run_iteration over a ('pop', 'rows') mesh via shard_map: each
     device advances its own island slice through the full iteration;
     frequency stats and the best-seen frontier stay globally lockstep via
@@ -1579,7 +1594,9 @@ def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn, data_specs=None
     specs = evo_state_specs()
     from jax.sharding import PartitionSpec as _P
 
-    fn = jax.shard_map(
+    from ..parallel.mesh import shard_map_compat
+
+    fn = shard_map_compat(
         lambda st, data: _run_iteration_impl(
             st, data, cfg_local, score_fn, axis="pop"
         ),
@@ -1591,7 +1608,9 @@ def make_sharded_iteration(mesh, cfg_local: EvoConfig, score_fn, data_specs=None
         # interpreter, same as parallel/sharding.py
         check_vma=False,
     )
-    return jax.jit(fn)
+    # donate: in-place state buffers for the pipelined engine loop (see
+    # run_iteration_donated)
+    return jax.jit(fn, donate_argnums=(0,)) if donate else jax.jit(fn)
 
 
 def _topn_pool(state: EvoState, cfg: EvoConfig):
@@ -1739,3 +1758,45 @@ def migrate_from_pool(
         return out
     state, replace, src = out
     return state, {"replace": replace, "src": src, "pool": pool}
+
+
+def scoring_cost_probe(
+    state: EvoState, data, cfg: EvoConfig, score_fn, repeats: int = 10, key=None
+):
+    """Estimate the scoring share of the fused iteration program.
+
+    One iteration is ONE XLA executable, so host timers cannot segment
+    tournament/mutation/crossover from scoring inside it. This probe times
+    the exact scoring call the program makes — ``score_fn`` on a
+    ``[2 * I * E]`` candidate batch, once per cycle (see ``_event``) —
+    standalone, and scales by ``cfg.ncycles``. ROOFLINE-style accounting:
+    the estimate ignores fusion between scoring and evolve bookkeeping, so
+    treat it as the separable scoring cost, not an exact decomposition.
+
+    Returns ``(scoring_ms_per_iteration, batch_rows)``.
+    """
+    import time as _time
+
+    I, P = cfg.n_islands, cfg.pop_size
+    E = min(cfg.events_per_cycle, P)
+    rows = 2 * I * E
+    idx = jnp.arange(rows, dtype=jnp.int32)
+    ii, pp = idx % I, idx % P
+    batch = Tree(
+        state.kind[ii, pp], state.op[ii, pp], state.lhs[ii, pp],
+        state.rhs[ii, pp], state.feat[ii, pp], state.val[ii, pp],
+        state.length[ii, pp],
+    )
+    if cfg.batching:
+        k = key if key is not None else jax.random.PRNGKey(0)
+        call = jax.jit(lambda b, d, kk: score_fn(b, d, kk))
+        args = (batch, data, k)
+    else:
+        call = jax.jit(lambda b, d: score_fn(b, d))
+        args = (batch, data)
+    call(*args).block_until_ready()  # compile outside the timed window
+    t0 = _time.perf_counter()
+    for _ in range(repeats):
+        call(*args).block_until_ready()
+    per_call = (_time.perf_counter() - t0) / repeats
+    return per_call * cfg.ncycles * 1e3, rows

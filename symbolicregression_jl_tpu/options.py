@@ -211,6 +211,25 @@ class Options:
     # fallback; False = always serial. Concurrent and serial execution are
     # seed-for-seed identical (per-output RNG streams either way).
     parallel_outputs: bool | None = None
+    # device engine: stage-level profiling (utils/profiling.StageProfiler).
+    # True segments each engine iteration into per-stage walls (evolve,
+    # const_opt, finalize, readback, exchange, decode_hof, simplify,
+    # migrate) with block_until_ready fencing, exposed as
+    # SearchResult.engine_profile. Fencing serializes the dispatch pipeline,
+    # so profiling forces the synchronous readback path; leave False for
+    # production runs (disabled overhead is <2%, see ENGINE_PROFILE_r06).
+    profile: bool = False
+    # device engine: software-pipelined device->host readback. The packed
+    # per-iteration readback (and the multi-host migration-pool exchange) of
+    # iteration i-1 is consumed while the device computes iteration i, with
+    # donated state buffers; migration then injects a ONE-ITERATION-STALE
+    # pool — semantically legitimate per the reference's async snapshot
+    # migration (/root/reference/src/SymbolicRegression.jl:933-943). Stop
+    # conditions (early_stop / max_evals) also lag one iteration. None
+    # (default) = auto: on for the device scheduler unless use_recorder or
+    # profile is set; False = always synchronous; True = explicit request
+    # (rejected with use_recorder, which needs lockstep replay).
+    async_readback: bool | None = None
 
     # -- derived (filled in __post_init__) -----------------------------------
     operators: OperatorSet = dataclasses.field(init=False)
@@ -263,6 +282,18 @@ class Options:
                 f"unsupported optimizer_algorithm {self.optimizer_algorithm!r}; "
                 "expected 'BFGS' or 'NelderMead' (1-constant trees always use "
                 "Newton, like the reference)"
+            )
+        if self.async_readback is True and self.use_recorder:
+            raise ValueError(
+                "async_readback=True is incompatible with use_recorder "
+                "(lineage replay consumes per-iteration logs in lockstep); "
+                "leave async_readback=None for auto"
+            )
+        if self.async_readback is True and self.profile:
+            raise ValueError(
+                "async_readback=True is incompatible with profile=True "
+                "(stage fencing serializes the pipeline the async path "
+                "exists to overlap); leave async_readback=None for auto"
             )
         if self.use_recorder and self.crossover_probability > 0:
             # recorder lineage is single-parent; same constraint as the

@@ -36,6 +36,8 @@ __all__ = [
     "is_distributed",
     "process_island_slice",
     "all_gather_migration_pool",
+    "allgather_transport",
+    "DoubleBufferedExchange",
 ]
 
 
@@ -82,17 +84,114 @@ def process_island_slice(n_islands: int) -> tuple[int, int]:
     return start, stop
 
 
+_KV_SEQ = 0
+_KV_TIMEOUT_MS = 600_000
+
+
+def _kv_allgather(arrays):
+    """Host-side allgather over the coordination service's key-value store.
+
+    jax's CPU backend cannot execute multi-process XLA computations (the
+    virtual-DCN test rig: N interpreters joined by jax.distributed on CPU),
+    which rules out ``multihost_utils.process_allgather`` there. The payload
+    rides the distributed runtime's KV store instead: every process posts its
+    serialized leaves under a sequence-numbered key, blocking-reads every
+    peer's, then a barrier + self-delete reclaims coordinator memory. The
+    call sequence is lockstep on every process (the engine loop guarantees
+    it), so sequence numbers stay aligned without extra synchronization."""
+    global _KV_SEQ
+    import io
+
+    import jax
+    from jax._src import distributed as _jdist
+
+    client = _jdist.global_state.client
+    assert client is not None, "jax.distributed is not initialized"
+    pid, n = jax.process_index(), jax.process_count()
+    seq = _KV_SEQ
+    _KV_SEQ += 1
+    leaves, treedef = jax.tree_util.tree_flatten(arrays)
+    buf = io.BytesIO()
+    np.savez(buf, *[np.asarray(a) for a in leaves])
+    client.key_value_set_bytes(f"srag/{seq}/{pid}", buf.getvalue())
+    gathered = []
+    for p in range(n):
+        raw = client.blocking_key_value_get_bytes(
+            f"srag/{seq}/{p}", _KV_TIMEOUT_MS
+        )
+        with np.load(io.BytesIO(raw)) as z:
+            gathered.append([z[f"arr_{j}"] for j in range(len(z.files))])
+    client.wait_at_barrier(f"srag-done/{seq}", _KV_TIMEOUT_MS)
+    client.key_value_delete(f"srag/{seq}/{pid}")
+    stacked = [
+        np.stack([g[j] for g in gathered]) for j in range(len(leaves))
+    ]
+    return jax.tree_util.tree_unflatten(treedef, stacked)
+
+
+def allgather_transport() -> str:
+    """Which transport ``all_gather_migration_pool`` rides on this runtime."""
+    import jax
+
+    if jax.process_count() > 1 and jax.default_backend() == "cpu":
+        return "kv-store"
+    return "xla-collective"
+
+
 def all_gather_migration_pool(local_pool_arrays):
     """Gather each host's compact migration pool (flattened best members:
     FlatTrees-style arrays + losses) into the global pool on every host.
 
     The only cross-host traffic of the island model — a few KB of flattened
     trees once per iteration, riding DCN (the reference ships whole pickled
-    Populations over TCP for the same purpose, SURVEY.md §2.3)."""
+    Populations over TCP for the same purpose, SURVEY.md §2.3). On TPU/GPU
+    this is ``process_allgather`` (an XLA collective); on the multi-process
+    CPU rig it falls back to the coordination-service KV store, since the
+    CPU backend refuses multi-process XLA computations."""
     import jax
     from jax.experimental import multihost_utils
 
+    if jax.process_count() > 1 and jax.default_backend() == "cpu":
+        return _kv_allgather(local_pool_arrays)
     return jax.tree_util.tree_map(
         lambda a: multihost_utils.process_allgather(np.asarray(a), tiled=False),
         local_pool_arrays,
     )
+
+
+class DoubleBufferedExchange:
+    """One-slot pipelined wrapper around ``all_gather_migration_pool``.
+
+    The per-iteration gather is a blocking host call (36–305 ms at 2–8
+    processes, MULTIHOST_COST_r05) that round 5 ran serially between device
+    iterations. ``roll(local)`` instead exchanges the PREVIOUS iteration's
+    payload and stashes this iteration's — the caller dispatches iteration
+    i's device programs first, so the blocking gather overlaps iteration i's
+    device compute, and migration injects a one-iteration-stale global pool.
+    Staleness is semantically licensed by the reference's async snapshot
+    migration (workers migrate from whatever best-seen snapshot the head
+    last broadcast, /root/reference/src/SymbolicRegression.jl:933-943).
+
+    Every process must call ``roll``/``flush`` the same number of times in
+    the same order (the engine loop is lockstep), keeping the collective
+    sequence deterministic across processes — no threads are involved.
+    """
+
+    def __init__(self):
+        self._pending = None
+
+    def roll(self, local_pool_arrays):
+        """Submit this iteration's local payload; gather and return the
+        previous iteration's global payload (None on the first call)."""
+        prev, self._pending = self._pending, local_pool_arrays
+        if prev is None:
+            return None
+        return all_gather_migration_pool(prev)
+
+    def flush(self):
+        """Drain the slot after the loop: gather and return the last
+        submitted payload (None if empty)."""
+        prev, self._pending = self._pending, None
+        if prev is None:
+            return None
+        return all_gather_migration_pool(prev)

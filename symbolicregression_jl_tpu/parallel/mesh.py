@@ -18,7 +18,35 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "population_sharding", "data_sharding", "P"]
+__all__ = [
+    "make_mesh",
+    "population_sharding",
+    "data_sharding",
+    "shard_map_compat",
+    "P",
+]
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=...)``; older releases
+    (<= 0.4.x, as shipped in some containers) only have
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)``. The two
+    flags mean the same thing (skip the replication/varying-manual-axes
+    check, needed for axis_index-dependent outputs).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
 
 
 def make_mesh(

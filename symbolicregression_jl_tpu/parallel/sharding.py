@@ -22,7 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.flat import FlatTrees
 from ..ops.interp import eval_trees
 from ..ops.operators import OperatorSet
-from .mesh import data_sharding, population_sharding
+from .mesh import data_sharding, population_sharding, shard_map_compat
 
 __all__ = ["make_sharded_loss", "shard_dataset", "shard_population"]
 
@@ -59,7 +59,7 @@ def make_sharded_loss(
         length=P("pop"),
     )
     w_spec = P("rows") if has_weights else P()
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         per_shard,
         mesh=mesh,
         in_specs=(flat_spec, P(None, "rows"), P("rows"), w_spec),

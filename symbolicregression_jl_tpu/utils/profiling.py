@@ -1,0 +1,179 @@
+"""Stage-level engine profiler (round 6).
+
+The device engine runs one iteration as a handful of dispatched XLA programs
+(evolve, const-opt, finalize, readback) plus host-side work (decode, hall of
+fame, simplify, exchange). ``StageProfiler`` segments one engine iteration
+into named stage walls so the end-to-end gap between kernel throughput
+(ROOFLINE_r05) and engine throughput (BENCH_r05) can be attributed — the
+device-engine counterpart of the reference's hot-loop accounting
+(/root/reference/src/SingleIteration.jl:24-105).
+
+Design constraints:
+
+- **Near-zero overhead when disabled.** ``Options.profile=False`` routes all
+  call sites through ``NULL_PROFILER``, whose ``stage()`` returns a shared
+  no-op context manager and whose ``fence()`` returns its argument untouched
+  — no timestamps, no dict writes, no ``block_until_ready``. Measured <2%
+  on the config-3 engine loop (ENGINE_PROFILE_r06.json, ``overhead``).
+- **Fencing only when enabled.** JAX dispatch is asynchronous: without a
+  fence a "stage wall" only measures dispatch cost. When profiling is on,
+  call sites pass the stage's output arrays to ``fence()`` so each stage
+  wall includes its device execution. This serializes the pipeline — which
+  is exactly why the profiler must never fence when disabled, and why
+  ``Options.profile=True`` forces the synchronous readback path.
+- **Ring buffer.** Per-iteration stage walls land in a bounded deque so a
+  long search cannot grow host memory; ``summary()`` aggregates whatever
+  the window holds (mean/p50/p90 per stage + fraction of iteration wall).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+__all__ = ["StageProfiler", "NULL_PROFILER"]
+
+
+class _NullCtx:
+    """Shared no-op context manager — the disabled profiler's only cost is
+    one attribute load and one method call per stage."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class _StageCtx:
+    __slots__ = ("_prof", "_name", "_t0")
+
+    def __init__(self, prof: "StageProfiler", name: str):
+        self._prof = prof
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        cur = self._prof._current
+        cur[self._name] = cur.get(self._name, 0.0) + dt
+        return False
+
+
+class StageProfiler:
+    """Per-iteration stage timer with a bounded ring buffer.
+
+    Usage (one engine iteration)::
+
+        with prof.stage("evolve"):
+            state = run_step(state, data)
+            prof.fence(state)          # include device wall, not just dispatch
+        ...
+        prof.next_iteration()          # close the iteration record
+
+    ``stage`` may be entered multiple times per iteration for the same name
+    (times accumulate). ``summary()`` reports per-stage mean/p50/p90 ms and
+    the fraction of the mean iteration wall, where the iteration wall is the
+    host time between consecutive ``next_iteration`` calls — so dispatch
+    overhead and unattributed host work show up as ``other``.
+    """
+
+    __slots__ = ("enabled", "_ring", "_current", "_iter_t0")
+
+    def __init__(self, enabled: bool = True, capacity: int = 512):
+        self.enabled = enabled
+        self._ring: deque = deque(maxlen=capacity)
+        self._current: dict = {}
+        self._iter_t0: float | None = None
+
+    # -- recording ----------------------------------------------------------
+    def stage(self, name: str):
+        if not self.enabled:
+            return _NULL_CTX
+        if self._iter_t0 is None:
+            self._iter_t0 = time.perf_counter()
+        return _StageCtx(self, name)
+
+    def fence(self, x):
+        """``jax.block_until_ready`` on ``x`` when enabled (pytrees ok);
+        identity when disabled. Returns ``x`` either way."""
+        if self.enabled and x is not None:
+            import jax
+
+            jax.block_until_ready(x)
+        return x
+
+    def next_iteration(self):
+        """Close the current iteration's record and push it to the ring."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        if self._iter_t0 is not None:
+            rec = self._current
+            rec["_wall"] = now - self._iter_t0
+            self._ring.append(rec)
+        self._current = {}
+        self._iter_t0 = now
+
+    # -- reporting ----------------------------------------------------------
+    @staticmethod
+    def _pct(sorted_vals, q):
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[i]
+
+    def summary(self) -> dict:
+        """Aggregate the ring buffer: per-stage ms stats + fraction of the
+        mean iteration wall, plus the unattributed remainder (``other``)."""
+        iters = list(self._ring)
+        n = len(iters)
+        if n == 0:
+            return {"iterations": 0, "stages": {}, "iteration_mean_ms": 0.0}
+        walls = [r.get("_wall", 0.0) for r in iters]
+        wall_mean = sum(walls) / n
+        names = []
+        for r in iters:
+            for k in r:
+                if k != "_wall" and k not in names:
+                    names.append(k)
+        stages = {}
+        attributed = 0.0
+        for name in names:
+            vals = [r.get(name, 0.0) for r in iters]
+            sv = sorted(vals)
+            mean = sum(vals) / n
+            attributed += mean
+            stages[name] = {
+                "mean_ms": mean * 1e3,
+                "p50_ms": self._pct(sv, 0.50) * 1e3,
+                "p90_ms": self._pct(sv, 0.90) * 1e3,
+                "total_ms": sum(vals) * 1e3,
+                "fraction": (mean / wall_mean) if wall_mean > 0 else 0.0,
+            }
+        other = max(0.0, wall_mean - attributed)
+        stages["other"] = {
+            "mean_ms": other * 1e3,
+            "p50_ms": other * 1e3,
+            "p90_ms": other * 1e3,
+            "total_ms": other * n * 1e3,
+            "fraction": (other / wall_mean) if wall_mean > 0 else 0.0,
+        }
+        return {
+            "iterations": n,
+            "iteration_mean_ms": wall_mean * 1e3,
+            "iteration_p50_ms": self._pct(sorted(walls), 0.50) * 1e3,
+            "iteration_p90_ms": self._pct(sorted(walls), 0.90) * 1e3,
+            "stages": stages,
+        }
+
+
+NULL_PROFILER = StageProfiler(enabled=False, capacity=1)

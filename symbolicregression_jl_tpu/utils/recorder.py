@@ -57,7 +57,12 @@ class Recorder:
         if not self.enabled:
             return
         key = f"out{out_j}_pop{pop_i}"
-        self.data.setdefault(key, {})[f"iteration{iteration}"] = pop.record(options)
+        # one recorder is shared across concurrent per-output search threads
+        # (parallel_outputs), same as the other record_* methods
+        with self._lock:
+            self.data.setdefault(key, {})[f"iteration{iteration}"] = pop.record(
+                options
+            )
 
     # -- mutation lineage -----------------------------------------------------
 

@@ -33,7 +33,16 @@ import pytest  # noqa: E402
 # default is the 8-device virtual CPU platform.
 if os.environ.get("SR_TPU_TESTS") != "1":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # jax < 0.5 spells the virtual-device count as an XLA flag; it is
+        # read at first backend init, which is still ahead of us (see the
+        # module docstring), so appending here works on those versions too
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,201 @@
+"""Round-6 engine pipeline: stage profiler, async double-buffered readback,
+and the const-opt AOT cache key.
+
+The async (software-pipelined) readback consumes iteration i-1's packed
+readback while the device computes iteration i. With simplify off there is
+no single-host state injection, so the device-side trajectory must be
+BIT-IDENTICAL to the synchronous path — only the host observes the frontier
+one iteration later. With simplify on, injections land one iteration stale
+(the reference's async snapshot-migration semantics,
+/root/reference/src/SymbolicRegression.jl:933-943) and the search must still
+converge.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.utils.profiling import NULL_PROFILER, StageProfiler
+
+
+# -- StageProfiler unit behavior ---------------------------------------------
+
+def test_stage_profiler_records_and_summarizes():
+    prof = StageProfiler(capacity=8)
+    for _ in range(3):
+        with prof.stage("a"):
+            time.sleep(0.002)
+        with prof.stage("b"):
+            time.sleep(0.001)
+        with prof.stage("b"):  # repeated stage accumulates
+            time.sleep(0.001)
+        prof.next_iteration()
+    s = prof.summary()
+    assert s["iterations"] == 3
+    assert set(s["stages"]) == {"a", "b", "other"}
+    assert s["stages"]["a"]["mean_ms"] >= 1.5
+    assert s["stages"]["b"]["mean_ms"] >= 1.5  # two sleeps accumulated
+    # fractions of the iteration wall sum to ~1 (other absorbs the rest)
+    total = sum(v["fraction"] for v in s["stages"].values())
+    assert 0.99 < total < 1.01
+    assert s["iteration_mean_ms"] >= s["stages"]["a"]["mean_ms"]
+
+
+def test_stage_profiler_ring_buffer_bounded():
+    prof = StageProfiler(capacity=4)
+    for i in range(10):
+        with prof.stage("x"):
+            pass
+        prof.next_iteration()
+    assert prof.summary()["iterations"] == 4
+
+
+def test_null_profiler_is_inert():
+    ctx1 = NULL_PROFILER.stage("anything")
+    ctx2 = NULL_PROFILER.stage("else")
+    assert ctx1 is ctx2  # shared no-op context, no allocation per stage
+    with ctx1:
+        pass
+    NULL_PROFILER.next_iteration()
+    assert NULL_PROFILER.summary()["iterations"] == 0
+    obj = object()
+    assert NULL_PROFILER.fence(obj) is obj
+
+
+def test_profiler_fence_blocks_pytrees():
+    import jax.numpy as jnp
+
+    prof = StageProfiler()
+    tree = {"a": jnp.ones(4), "b": (jnp.zeros(2), jnp.ones(1))}
+    assert prof.fence(tree) is tree  # block_until_ready on every leaf
+
+
+# -- Options surface ----------------------------------------------------------
+
+def test_async_readback_rejected_with_recorder():
+    with pytest.raises(ValueError, match="async_readback"):
+        Options(
+            save_to_file=False, use_recorder=True, crossover_probability=0.0,
+            async_readback=True,
+        )
+
+
+def test_async_readback_rejected_with_profile():
+    with pytest.raises(ValueError, match="async_readback"):
+        Options(save_to_file=False, profile=True, async_readback=True)
+
+
+# -- async readback on the device engine --------------------------------------
+
+def _planted():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 96)).astype(np.float32)
+    y = (X[0] * 2.1 + X[1]).astype(np.float32)
+    return X, y
+
+
+def _engine_opts(**kw):
+    base = dict(
+        binary_operators=["+", "*", "-"], unary_operators=["sin"],
+        populations=4, population_size=24, ncycles_per_iteration=30,
+        maxsize=12, save_to_file=False, seed=0, scheduler="device",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def test_async_readback_bit_identical_to_sync():
+    """With simplify off, the pipelined loop runs the same device programs in
+    the same order as the synchronous loop — final populations AND frontier
+    must match bit for bit at a fixed seed."""
+    X, y = _planted()
+
+    def run(async_rb):
+        opts = _engine_opts(async_readback=async_rb, should_simplify=False)
+        res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+        pops = [
+            [(str(m.tree), m.loss) for m in p.members] for p in res.populations
+        ]
+        front = sorted(
+            (m.get_complexity(opts), m.loss) for m in res.pareto_frontier
+        )
+        return pops, front, res.num_evals
+
+    pops_s, front_s, ev_s = run(False)
+    pops_a, front_a, ev_a = run(True)
+    assert pops_s == pops_a
+    assert front_s == front_a
+    assert ev_s == ev_a
+
+
+def test_async_readback_with_simplify_converges():
+    """Simplify pools inject one iteration stale in the pipelined loop; the
+    search must still recover the planted equation."""
+    X, y = _planted()
+    opts = _engine_opts(async_readback=True, should_simplify=True)
+    res = equation_search(X, y, options=opts, niterations=4, verbosity=0)
+    assert min(m.loss for m in res.pareto_frontier) < 1e-4
+
+
+def test_profile_mode_reports_stage_breakdown():
+    X, y = _planted()
+    opts = _engine_opts(profile=True)
+    res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    prof = res.engine_profile
+    assert prof["iterations"] == 3
+    stages = prof["stages"]
+    assert "evolve" in stages and "readback_d2h" in stages
+    assert stages["evolve"]["fraction"] > 0
+    # per-stage fractions (incl. the unattributed remainder) cover the wall
+    assert 0.99 < sum(v["fraction"] for v in stages.values()) < 1.01
+
+
+# -- const-opt AOT cache key regression (ADVICE r05, medium) ------------------
+
+def _mse_objective(preds, y, weights):
+    import jax.numpy as jnp
+
+    err = (preds - y[None, :]) ** 2
+    if weights is not None:
+        return jnp.sum(err * weights[None, :], axis=-1) / jnp.sum(weights)
+    return jnp.mean(err, axis=-1)
+
+
+def _doubled_objective(preds, y, weights):
+    import jax.numpy as jnp
+
+    err = (2.0 * preds - y[None, :]) ** 2
+    if weights is not None:
+        return jnp.sum(err * weights[None, :], axis=-1) / jnp.sum(weights)
+    return jnp.mean(err, axis=-1)
+
+
+def test_copt_cache_key_distinguishes_traceable_objectives():
+    """Two same-shape searches with DIFFERENT loss_function_jit objectives:
+    the second must optimize constants against ITS objective, not a stale
+    compiled const-opt program from the first (the k_copt tuple omitted
+    loss_function_jit before round 6). Under the doubled objective the best
+    fit of c*x1 to y=3.37*x1 is c=1.685 — a constant only const-opt finds."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1, 80)).astype(np.float32)
+    y = (3.37 * X[0]).astype(np.float32)
+
+    def run(objective):
+        opts = Options(
+            binary_operators=["*", "+"],
+            loss_function_jit=objective,
+            populations=4, population_size=16, ncycles_per_iteration=40,
+            maxsize=8, save_to_file=False, seed=0, scheduler="device",
+            optimizer_probability=1.0,
+        )
+        res = equation_search(X, y, options=opts, niterations=4, verbosity=0)
+        return min(m.loss for m in res.pareto_frontier)
+
+    # first search populates the AOT cache with the plain-MSE objective
+    assert run(_mse_objective) < 1e-2
+    # a stale cached const-opt program would tune c toward 3.37, leaving the
+    # doubled objective's loss at ~(3.37)^2 * E[x^2] (~11 here); the fix
+    # keeps it tiny
+    assert run(_doubled_objective) < 1e-2

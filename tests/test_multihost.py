@@ -1,7 +1,8 @@
 """Multi-host orchestration: a REAL 2-process search over jax.distributed.
 
 Spawns two fresh interpreters that join one JAX runtime via
-``jax.distributed.initialize`` (Gloo CPU collectives standing in for DCN),
+``jax.distributed.initialize`` (the coordination-service KV allgather
+standing in for DCN collectives on the CPU backend),
 each owning half the islands (process_island_slice), exchanging the
 migration pool + readback once per iteration (all_gather_migration_pool),
 and both must converge on the planted equation with IDENTICAL halls of fame
@@ -88,6 +89,51 @@ else:
 """
 
 
+_STALE_POOL_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+from symbolicregression_jl_tpu.parallel.distributed import initialize, is_distributed
+initialize(coordinator_address="localhost:{port}", num_processes=2, process_id=pid)
+assert is_distributed(), "expected a 2-process runtime"
+
+import numpy as np
+from symbolicregression_jl_tpu import Options, equation_search
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2, 100)).astype(np.float32)
+y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+# migration cranked up so the one-iteration-stale pools of the pipelined
+# exchange (DoubleBufferedExchange) are injected every iteration on both the
+# topn and the hall-of-fame paths
+options = Options(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    populations=4,
+    population_size=16,
+    ncycles_per_iteration=60,
+    maxsize=14,
+    fraction_replaced=0.2,
+    fraction_replaced_hof=0.2,
+    save_to_file=False,
+    seed=0,
+    scheduler="device",
+    async_readback=True,
+)
+res = equation_search(X, y, options=options, niterations=5, verbosity=0)
+best = min(m.loss for m in res.pareto_frontier)
+frontier = ";".join(
+    f"{{m.get_complexity(options)}}:{{m.loss:.6g}}"
+    for m in sorted(res.hall_of_fame.pareto_frontier(),
+                    key=lambda m: m.get_complexity(options))
+)
+print(f"RESULT p{{pid}} best={{best:.6g}} evals={{res.num_evals:.0f}} "
+      f"frontier=[{{frontier}}]", flush=True)
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("localhost", 0))
@@ -101,8 +147,16 @@ def _run_pair(tmp_path, template, port, timeout=900):
     script.write_text(template.format(repo=REPO, port=port))
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
+    # conftest may force 8 virtual CPU devices per host via XLA_FLAGS for the
+    # in-process sharding tests; workers must NOT inherit it — a 2-process
+    # x 8-device mesh pushes process_allgather onto XLA's (unsupported)
+    # multiprocess-CPU computation path. Each worker keeps 1 device.
+    xla_flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    )
     env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "")
+        xla_flags
         + " --xla_cpu_enable_fast_math=true"
         " --xla_cpu_fast_math_honor_nans=true"
         " --xla_cpu_fast_math_honor_infs=true"
@@ -156,6 +210,31 @@ def test_two_process_search_recovers_and_stays_lockstep(tmp_path):
     assert evals > 2000
     # ...and the halls of fame are IDENTICAL across processes: the readback
     # allgather makes every process merge the same global frontier
+    f0 = results["p0"].split("frontier=")[1]
+    f1 = results["p1"].split("frontier=")[1]
+    assert f0 == f1, f"\np0: {f0}\np1: {f1}"
+
+
+def test_stale_pool_migration_stays_lockstep(tmp_path):
+    """Pipelined exchange (async_readback=True): migration reads a pool that
+    is one iteration stale, but because BOTH processes gather the same stale
+    payload at the same loop position, the hall of fame must remain identical
+    across processes — and the search must still recover the planted
+    equation through the delayed injections."""
+    procs, outs = _run_pair(tmp_path, _STALE_POOL_WORKER, _free_port())
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT p"):
+                results[line.split()[1]] = line
+    assert set(results) == {"p0", "p1"}, results
+
+    for tag in ("p0", "p1"):
+        best = float(results[tag].split("best=")[1].split()[0])
+        assert best < 1.5, results[tag]
     f0 = results["p0"].split("frontier=")[1]
     f1 = results["p1"].split("frontier=")[1]
     assert f0 == f1, f"\np0: {f0}\np1: {f1}"

@@ -179,13 +179,14 @@ from symbolicregression_jl_tpu.models.device_search import (  # noqa: E402
     _shard_const_opt,
     score_data_specs,
 )
+from symbolicregression_jl_tpu.parallel.mesh import shard_map_compat  # noqa: E402
 from jax.sharding import PartitionSpec as PSpec  # noqa: E402
 
 
 def _rows_score_call(mesh, score_fn, data):
     specs = score_data_specs(data)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda b, d: score_fn(b, d), mesh=mesh,
             in_specs=(PSpec(), specs), out_specs=PSpec(), check_vma=False,
         )
