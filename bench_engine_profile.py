@@ -16,9 +16,18 @@ config-3 engine iteration spend its time once the scoring kernel itself is
    vs the synchronous loop, evals/s and best_loss, plus a microbench of the
    disabled profiler's per-stage cost (the <2% overhead claim).
 
+Round 7 adds ``--ab``: the same profiled run is repeated under
+``SR_COPT_COMPAT=1`` (legacy const-opt — permutation selection, no length
+compaction, no convergence gate) so the artifact carries a like-for-like
+const_opt stage comparison against both the in-run legacy baseline and the
+committed r06 reference numbers.
+
 Usage::
 
     JAX_PLATFORMS=cpu python bench_engine_profile.py --niterations 4
+    JAX_PLATFORMS=cpu python bench_engine_profile.py --tiny          # CI smoke
+    JAX_PLATFORMS=cpu python bench_engine_profile.py --ab --profile-iters 2 \
+        --out ENGINE_PROFILE_r07.json
     python bench_engine_profile.py --full-config3 --out ENGINE_PROFILE_r06.json
 
 On non-TPU hosts the default config is a scaled config-3 (same operator set
@@ -44,10 +53,22 @@ def _engine_options(kwargs, **overrides):
     return Options(**base)
 
 
-def _config(full_config3: bool):
+def _config(full_config3: bool, tiny: bool = False):
     from bench_problems import config3_problem
 
     X, y, kwargs = config3_problem()
+    if tiny:
+        # CI smoke: exercise every code path (profiled run, probe, A/B)
+        # in minutes on a CPU runner — the numbers are meaningless, the
+        # invocation staying green is the point
+        return (
+            X[:, :200],
+            y[:200],
+            dict(
+                kwargs, populations=2, population_size=8,
+                ncycles_per_iteration=8, maxsize=13,
+            ),
+        )
     if not full_config3:
         # scaled config-3: identical operators/maxsize, 1/25th the events per
         # iteration — the stage STRUCTURE is what the profile measures
@@ -125,18 +146,55 @@ def main():
                     help="iterations for the profiled run (default: --niterations)")
     ap.add_argument("--full-config3", action="store_true",
                     help="unscaled config-3 (use on TPU hosts)")
+    ap.add_argument("--tiny", action="store_true",
+                    help="CI smoke: tiny problem + config, 2 iterations")
+    ap.add_argument("--ab", action="store_true",
+                    help="repeat the profiled run under SR_COPT_COMPAT=1 "
+                         "(legacy const-opt) and emit the stage comparison")
     ap.add_argument("--out", default=None, help="write the artifact JSON here")
     args = ap.parse_args()
 
+    import os
+
     import jax
 
+    # full bucket ladder: at profile-scale configs the per-iteration runtime
+    # dwarfs the extra per-bucket compiles the conservative default avoids
+    os.environ.setdefault("SR_BUCKET_MIN", "8")
+
     platform = jax.devices()[0].platform
-    X, y, kwargs = _config(args.full_config3)
+    X, y, kwargs = _config(args.full_config3, tiny=args.tiny)
+    if args.tiny:
+        args.niterations = min(args.niterations, 2)
     n_prof = args.profile_iters or args.niterations
 
     # 1) profiled run (forces the synchronous loop; fences every stage)
     res_p, options = _run_search(X, y, kwargs, n_prof, profile=True)
     profile = res_p.engine_profile
+
+    # 1b) const-opt A/B: the identical profiled run with the legacy const-opt
+    # engine (SR_COPT_COMPAT=1 at build time: permutation selection, full-N
+    # dispatch, fixed-iteration scan) as the in-run baseline
+    const_opt_ab = None
+    if args.ab or args.tiny:
+        os.environ["SR_COPT_COMPAT"] = "1"
+        try:
+            res_c, _ = _run_search(X, y, kwargs, n_prof, profile=True)
+        finally:
+            del os.environ["SR_COPT_COMPAT"]
+        prof_c = res_c.engine_profile
+        ms_base = prof_c["stages"].get("const_opt", {}).get("mean_ms", 0.0)
+        ms_new = profile["stages"].get("const_opt", {}).get("mean_ms", 0.0)
+        const_opt_ab = {
+            "baseline_compat": {
+                "iteration_mean_ms": prof_c.get("iteration_mean_ms"),
+                "stages": prof_c["stages"],
+                "best_loss": float(min(m.loss for m in res_c.pareto_frontier)),
+            },
+            "new_best_loss": float(min(m.loss for m in res_p.pareto_frontier)),
+            "const_opt_mean_ms": {"baseline_compat": ms_base, "new": ms_new},
+            "const_opt_speedup_in_run": round(ms_base / max(ms_new, 1e-9), 4),
+        }
 
     # 2) scoring share inside the fused evolve program
     probe = _scoring_probe(X, y, options, args.niterations)
@@ -169,6 +227,7 @@ def main():
             **{k: v for k, v in kwargs.items()
                if not callable(v) and k != "loss_function_jit"},
             "niterations": args.niterations,
+            "SR_BUCKET_MIN": os.environ["SR_BUCKET_MIN"],
         },
         "profiled": profile,
         "scoring_probe": probe,
@@ -184,6 +243,18 @@ def main():
             profile.get("iteration_mean_ms", 0.0)
         ),
     }
+    if const_opt_ab is not None:
+        ms_new = const_opt_ab["const_opt_mean_ms"]["new"]
+        if (not args.tiny and not args.full_config3
+                and platform == "cpu"):
+            # committed round-6 reference (same config3_scaled CPU protocol)
+            const_opt_ab["r06_reference"] = {
+                "const_opt_mean_ms": 168285.24,
+                "const_opt_speedup_vs_r06": round(
+                    168285.24 / max(ms_new, 1e-9), 4
+                ),
+            }
+        out["const_opt_ab"] = const_opt_ab
     text = json.dumps(out, indent=2)
     print(text)
     if args.out:

@@ -35,7 +35,9 @@ from ..dataset import Dataset
 from ..options import Options
 from ..ops.evolve import EvoConfig, EvoState, _score_of, init_state, run_iteration
 from ..ops.flat import (
-    KIND_CONST, FlatTrees, batch_bucket, flatten_trees, unflatten_tree,
+    KIND_CONST, FlatTrees, batch_bucket, bucket_min, bucket_sizes,
+    flatten_trees,
+    length_buckets_enabled, unflatten_tree,
 )
 from ..ops.treeops import Tree
 from .hall_of_fame import HallOfFame
@@ -320,6 +322,11 @@ def _make_score_fn(
         has_w,
         rows_axis,
         rows_shards,
+        # the bucketed-dispatch gate and ladder are baked into the built
+        # closure; a flipped SR_LENGTH_BUCKETS / SR_BUCKET_MIN between
+        # searches must not reuse it
+        length_buckets_enabled(),
+        bucket_min(),
     )
     with _CACHE_LOCK:
         fn = _SCORE_FN_CACHE.get(fn_key)
@@ -580,10 +587,35 @@ def _build_score_fn(
 
     # scan-interpreter fallback (CPU tests, non-lowerable operator sets,
     # traceable full objectives)
+    from ..ops.flat import slice_nodes
     from ..ops.interp import eval_trees
     from ..ops.losses import weighted_mean_loss
 
     objective = options.loss_function_jit
+    bsizes = bucket_sizes(N)
+    bucketed = length_buckets_enabled() and len(bsizes) > 1
+
+    def _eval_bucketed(flat, Xs):
+        # length-bucketed dispatch: run the scan at the smallest bucket
+        # holding the batch's longest tree. score_fn is never called under
+        # vmap (_event and finalize score plain batches; lax.map is a scan),
+        # so the switch stays a real runtime branch — only the chosen
+        # bucket's scan executes. Truncation is bit-exact: pad slots write
+        # zeros and are never read by live slots.
+        if not bucketed:
+            return eval_trees(flat, Xs, opset)
+        bidx = jnp.searchsorted(
+            jnp.asarray(bsizes, jnp.int32), jnp.max(flat.length)
+        )
+
+        def mk(n_b):
+            def branch(operands):
+                f, X_ = operands
+                return eval_trees(slice_nodes(f, n_b), X_, opset)
+
+            return branch
+
+        return lax.switch(bidx, [mk(n) for n in bsizes], (flat, Xs))
 
     def score_fn(batch, data: ScoreData, key=None):
         flat = FlatTrees(
@@ -602,7 +634,7 @@ def _build_score_fn(
             Xs, ys = data.Xd[:, idx], data.yd[idx]
             ws = None if data.wd is None else data.wd[idx]
             wsum = _batch_wsum(data, idx)
-        preds = eval_trees(flat, Xs, opset)
+        preds = _eval_bucketed(flat, Xs)
         if objective is not None:
             # traceable full objective (Options.loss_function_jit); rows
             # sharding is excluded by device_mode_supported so no _combine
@@ -685,6 +717,15 @@ def _make_const_opt_fn(
     chunk = min(chunk, K, I * P)
     n_chunks = min(-(-K // chunk), (I * P) // chunk)
     K = n_chunks * chunk
+    # hot-path upgrades (each revertible via _copt_env for A/Bs and
+    # identity tests): constant-aware selection, convergence gating at
+    # Options.optimizer_g_tol, and length compaction — sort the K selected
+    # members by length and run each chunk at the smallest node bucket
+    # holding its longest tree (bucket_sizes policy, O(log N) programs)
+    compat, no_compact = _copt_env()
+    g_tol = 0.0 if compat else float(options.optimizer_g_tol)
+    bsizes = bucket_sizes(N)
+    compact = not compat and not no_compact and len(bsizes) > 1
 
     def const_opt(state: EvoState, data) -> EvoState:
         if batch_rows is None:
@@ -719,8 +760,17 @@ def _make_const_opt_fn(
                 )
 
         key, ii, pp, val0, mask, starts = _select_and_jitter(
-            state, K, S, I, P, axis=axis
+            state, K, S, I, P, axis=axis, const_aware=not compat,
         )
+        if compact:
+            # length compaction: sorting groups similar lengths into the
+            # same chunk so most chunks dispatch to a small bucket. Sorting
+            # happens AFTER the jitter draw — every member keeps its own
+            # starts, so results are permutation-invariant (accept/scatter
+            # addresses by the co-sorted ii/pp).
+            order = jnp.argsort(state.length[ii, pp])
+            ii, pp = ii[order], pp[order]
+            val0, mask, starts = val0[order], mask[order], starts[order]
 
         def field(a):
             return a[ii, pp]
@@ -734,7 +784,7 @@ def _make_const_opt_fn(
             def per_restart(v0):
                 return optimize_single(
                     loss_fn, v0, struct_p, Xd, yd, wd, has_w, mask_p, iters,
-                    combine=combine,
+                    combine=combine, g_tol=g_tol,
                 )
 
             vals, fs = jax.vmap(per_restart)(starts_p)
@@ -743,7 +793,39 @@ def _make_const_opt_fn(
             return vals[best], fs[best]
 
         def per_chunk(args):
-            return jax.vmap(per_tree)(*args)
+            struct_c, starts_c, mask_c = args
+            if not compact:
+                return jax.vmap(per_tree)(struct_c, starts_c, mask_c)
+            # dispatch this chunk at the smallest bucket holding its longest
+            # tree. lax.map runs chunks as a scan, so the switch is a real
+            # runtime branch (switch-under-vmap would execute all branches)
+            bidx = jnp.searchsorted(
+                jnp.asarray(bsizes, jnp.int32), jnp.max(struct_c.length)
+            )
+
+            def mk(n_b):
+                def branch(operands):
+                    sc, stc, mc = operands
+                    sb = _Structure(
+                        sc.kind[:, :n_b], sc.op[:, :n_b], sc.lhs[:, :n_b],
+                        sc.rhs[:, :n_b], sc.feat[:, :n_b], sc.length,
+                    )
+                    vals_b, fs_b = jax.vmap(per_tree)(
+                        sb, stc[:, :, :n_b], mc[:, :n_b]
+                    )
+                    # pad back to [chunk, N] with each member's own val0
+                    # tail (starts[:, 0] is the unjittered val0) so the
+                    # accept/scatter contract sees full-width vectors
+                    return (
+                        jnp.concatenate([vals_b, stc[:, 0, n_b:]], axis=1),
+                        fs_b,
+                    )
+
+                return branch
+
+            return lax.switch(
+                bidx, [mk(n) for n in bsizes], (struct_c, starts_c, mask_c)
+            )
 
         chunked = jax.tree_util.tree_map(
             lambda a: a.reshape((n_chunks, chunk) + a.shape[1:]),
@@ -793,10 +875,37 @@ def _make_const_opt_fn(
     return const_opt if axis is not None else jax.jit(const_opt)
 
 
-def _select_and_jitter(state: EvoState, K: int, S: int, I: int, P: int, axis=None):
+def _copt_env() -> tuple[bool, bool]:
+    """Trace-time env gates for the engine const-opt, read when a builder
+    runs (NOT per call) and included in the AOT/jit cache keys so flipping
+    them between searches can never reuse a stale executable:
+
+    - ``SR_COPT_COMPAT=1``: restore the legacy const-opt wholesale —
+      permutation selection, no length compaction, no convergence gating
+      (the bench A/B's baseline side).
+    - ``SR_NO_COPT_COMPACT=1``: disable ONLY the length compaction (same
+      selection and gating; the compaction bit-identity test's off side).
+    """
+    compat = os.environ.get("SR_COPT_COMPAT") == "1"
+    no_compact = os.environ.get("SR_NO_COPT_COMPACT") == "1"
+    return compat, no_compact
+
+
+def _select_and_jitter(
+    state: EvoState, K: int, S: int, I: int, P: int, axis=None,
+    const_aware: bool = False,
+):
     """Shared const-opt front half: pick K distinct member slots and build
     the x(1 + 0.5*randn) restart starts [K, S, N] (reference's perturbed
     re-starts, /root/reference/src/ConstantOptimization.jl:53-68).
+
+    ``const_aware``: bias selection to members with >=1 constant slot — the
+    reference only ever optimizes trees with constants
+    (/root/reference/src/ConstantOptimization.jl), while a uniform draw
+    burns BFGS lanes on fully-masked no-ops. Members get priority
+    uniform(0,1) + has_const and the top K are taken: const-bearing members
+    always outrank const-free ones, uniformly at random within each group,
+    and selection stays K distinct slots.
 
     ``axis``: shard_map mode — each shard folds its axis index into the
     (replicated) key so shards pick different members; the key returned here
@@ -810,7 +919,12 @@ def _select_and_jitter(state: EvoState, K: int, S: int, I: int, P: int, axis=Non
 
         base_key = jax.random.fold_in(base_key, lax.axis_index(axis))
     key, k_sel, k_jit = jax.random.split(base_key, 3)
-    flat_idx = jax.random.permutation(k_sel, I * P)[:K]
+    if const_aware:
+        has_const = jnp.any(state.kind == KIND_CONST, axis=-1).reshape(-1)
+        prio = jax.random.uniform(k_sel, (I * P,)) + has_const
+        flat_idx = jnp.argsort(-prio)[:K]
+    else:
+        flat_idx = jax.random.permutation(k_sel, I * P)[:K]
     ii, pp = flat_idx // P, flat_idx % P
     kind = state.kind[ii, pp]
     val0 = state.val[ii, pp]  # engine dtype (f32 or f64)
@@ -953,6 +1067,11 @@ def _make_const_opt_fn_pallas(
     S = 1 + options.optimizer_nrestarts
     B = _round_up(K * S, P_TILE_LOSS)
     iters = int(options.optimizer_iterations)
+    # convergence gating + constant-aware selection (see _make_const_opt_fn;
+    # SR_COPT_COMPAT=1 restores the legacy path). Length compaction does not
+    # apply here: the kernel pads the node axis to 128 lanes regardless.
+    compat, _ = _copt_env()
+    g_tol = 0.0 if compat else float(options.optimizer_g_tol)
     opset, loss_elem = options.operators, options.loss
     Lv = _round_up(N, 128)
     R_eff = n_rows if batch_rows is None else batch_rows
@@ -1011,7 +1130,7 @@ def _make_const_opt_fn_pallas(
             return comb(f), comb(g)
 
         key, ii, pp, val0, mask_k, starts = _select_and_jitter(
-            state, K, S, I, P, axis=axis
+            state, K, S, I, P, axis=axis, const_aware=not compat,
         )
         starts = starts.reshape(K * S, N)
 
@@ -1098,7 +1217,24 @@ def _make_const_opt_fn_pallas(
             H_next = jnp.where(good[:, None, None], H_new, H)
             return (x_new, H_next, f_next, g_new), None
 
-        (xs, _, fs, _), _ = lax.scan(body, (starts, eye, f0, g0), None, length=iters)
+        # convergence-gated lockstep: exit once EVERY instance's masked
+        # gradient inf-norm is under g_tol (or iters is reached). The whole
+        # batch advances together, so the gate is the batch max; g_tol=0
+        # keeps the test false forever -> exact legacy iteration count. g in
+        # the carry is already psum-combined (vgrad), so the condition runs
+        # no collective.
+        def w_cond(carry):
+            x, H, f, g, k = carry
+            return (k < iters) & ~(jnp.max(jnp.abs(g)) < g_tol)
+
+        def w_body(carry):
+            x, H, f, g, k = carry
+            (x, H, f, g), _ = body((x, H, f, g), None)
+            return (x, H, f, g, k + 1)
+
+        (xs, _, fs, _, _) = lax.while_loop(
+            w_cond, w_body, (starts, eye, f0, g0, jnp.asarray(0, jnp.int32))
+        )
 
         # best restart per tree
         fs = jnp.where(jnp.isfinite(fs), fs, jnp.inf)[: K * S].reshape(K, S)
@@ -1769,6 +1905,10 @@ def device_search_one_output(
                 options.optimizer_probability,
                 options.optimizer_nrestarts, options.optimizer_iterations,
                 options.optimizer_algorithm,
+                # gating tolerance, the compat/compaction env gates, and the
+                # bucket ladder are baked into the compiled const-opt
+                # program (while_loop bound, selection mechanism, switch)
+                options.optimizer_g_tol, _copt_env(), bucket_min(),
                 (pop_shards, rows_shards) if mesh else 0,
             )
             copt_step = _AOT_CACHE.get(k_copt)

@@ -22,6 +22,7 @@ from ..dataset import Dataset
 from ..ops.flat import batch_bucket as _bucket
 from ..ops.flat import flatten_trees
 from ..ops.scoring import (
+    batched_loss_bucketed,
     batched_loss_jit,
     baseline_loss,
     loss_to_score,
@@ -165,16 +166,22 @@ class BatchScorer:
                 flat, X, y, w, self.opset, self.loss_elem
             )
         else:
-            dev_losses = batched_loss_jit(
-                flat, X, y, w, self.opset, self.loss_elem, use_pallas=False
+            # scan-interpreter fallback: length-bucketed dispatch — each
+            # sub-batch pays a scan sized to its bucket, not max_nodes
+            # (bit-identical losses; see ops/scoring.batched_loss_bucketed)
+            dev_losses = None
+            fetch = batched_loss_bucketed(
+                flat, X, y, w, self.opset, self.loss_elem
             )
-        try:
-            dev_losses.copy_to_host_async()
-        except Exception:
-            pass
+        if dev_losses is not None:
+            try:
+                dev_losses.copy_to_host_async()
+            except Exception:
+                pass
+            fetch = lambda: np.asarray(dev_losses)  # noqa: E731
 
         def materialize() -> np.ndarray:
-            losses = np.asarray(dev_losses)[:P].astype(np.float64)
+            losses = fetch()[:P].astype(np.float64)
             if self._units_penalty is not None:
                 from ..dimensional_analysis import violates_dimensional_constraints
 

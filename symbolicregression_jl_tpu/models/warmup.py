@@ -24,10 +24,43 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..ops.flat import batch_bucket
-from ..tree import constant
+from ..ops.flat import batch_bucket, bucket_sizes, length_buckets_enabled
+from ..tree import Node, constant
 
 __all__ = ["warmup_host_programs"]
+
+
+def _chain_tree(n_nodes: int, opset) -> Node:
+    """A valid tree with close to (and never more than) ``n_nodes`` nodes
+    and at least one constant — sized to land in a given length bucket so
+    warmup touches that bucket's compiled program."""
+    t = constant(1.0)
+    size = 1
+    if opset.n_binary:
+        while size + 2 <= n_nodes:
+            t = Node(2, op=0, l=t, r=constant(1.0))
+            size += 2
+    elif opset.n_unary:
+        while size + 1 <= n_nodes:
+            t = Node(1, op=0, l=t)
+            size += 1
+    return t
+
+
+def _bucket_mix(count: int, options) -> list[Node]:
+    """``count`` warmup trees spread across the length buckets (equal split)
+    so the bucketed dispatch compiles each node-bucket program up front.
+    Best-effort: runtime per-bucket sub-batch sizes vary with the length
+    distribution, so uncommon (bucket, batch) pairs may still compile lazily
+    — the compile-count bound O(buckets x log P) holds regardless."""
+    sizes = bucket_sizes(options.max_nodes)
+    if not length_buckets_enabled() or len(sizes) == 1:
+        return [constant(1.0)] * count
+    trees = [
+        _chain_tree(sizes[k % len(sizes)] - 1, options.operators)
+        for k in range(count)
+    ]
+    return trees
 
 
 def warmup_host_programs(scorer, options) -> None:
@@ -42,13 +75,12 @@ def warmup_host_programs(scorer, options) -> None:
         opt_n = max(1, int(round(I * P * options.optimizer_probability)))
     buckets = sorted({batch_bucket(c) for c in score_sizes})
     saved_evals = scorer.num_evals
-    dummy = constant(1.0)
     idxs: list = [None]
     if options.batching:
         idxs.append(scorer.batch_indices(wrng))
     for b in buckets:
         for idx in idxs:
-            scorer.loss_many([dummy] * b, idx=idx)
+            scorer.loss_many(_bucket_mix(b, options), idx=idx)
     if options.should_optimize_constants and options.optimizer_probability > 0:
         from ..ops.constant_opt import optimize_constants_batched
 
@@ -59,7 +91,7 @@ def warmup_host_programs(scorer, options) -> None:
         # crash at 1M rows)
         opt_idx = scorer.batch_indices(wrng) if options.batching else None
         optimize_constants_batched(
-            [dummy] * opt_n, scorer, options, wrng, idx=opt_idx
+            _bucket_mix(opt_n, options), scorer, options, wrng, idx=opt_idx
         )
     # warmup evals are not real search work: keep the throughput metric honest
     scorer.num_evals = saved_evals

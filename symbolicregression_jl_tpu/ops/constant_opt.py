@@ -20,7 +20,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .flat import KIND_CONST, FlatTrees, batch_bucket, flatten_trees
+from .flat import (
+    KIND_CONST,
+    FlatTrees,
+    batch_bucket,
+    flatten_trees,
+    length_buckets,
+    length_buckets_enabled,
+    slice_nodes,
+)
 from .interp import _Structure, _eval_one
 from .losses import weighted_mean_loss
 from .operators import OperatorSet
@@ -40,10 +48,16 @@ def _tree_loss_fn(opset: OperatorSet, loss_elem: Callable):
 
 
 def _bfgs_single(
-    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None
+    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None,
+    g_tol: float = 0.0,
 ):
-    """Fixed-iteration BFGS with Armijo backtracking on one tree's constants.
-    mask[N]: which slots are free parameters. Returns (val, f).
+    """Convergence-gated BFGS with Armijo backtracking on one tree's
+    constants. mask[N]: which slots are free parameters. Returns (val, f).
+
+    ``g_tol``: Optim.jl g_tol semantics — stop as soon as the masked
+    gradient's inf-norm drops below it (or ``iters`` is reached). g_tol=0
+    reproduces the legacy fixed-iteration behavior exactly: the exit test is
+    ``~(|g|_inf < g_tol)`` so neither 0 nor NaN gradients trip it early.
 
     ``combine``: rows-sharded mode (shard_map) — ``loss_fn`` then sees only
     this shard's row block and ``combine`` merges per-shard values into the
@@ -51,7 +65,9 @@ def _bfgs_single(
     applies to losses and to every gradient component, so one callable
     covers both; it must be applied OUTSIDE jax.grad (autodiff through a
     forward psum yields only the local gradient piece, which would diverge
-    the rows-replicated state)."""
+    the rows-replicated state). The convergence test reads the
+    already-combined gradient from the carry, so no collective runs inside
+    the while condition."""
     N = val0.shape[0]
     dtype = val0.dtype
     eye = jnp.eye(N, dtype=dtype)
@@ -103,18 +119,34 @@ def _bfgs_single(
 
         return (x_new, H_next, f_next, g_new), None
 
-    (x, _, f, _), _ = lax.scan(body, (val0, eye, f0, g0), None, length=iters)
+    def w_cond(carry):
+        x, H, f, g, k = carry
+        # ~(norm < g_tol): continue on NaN and on g_tol=0 (legacy behavior)
+        return (k < iters) & ~(jnp.max(jnp.abs(g)) < g_tol)
+
+    def w_body(carry):
+        x, H, f, g, k = carry
+        (x, H, f, g), _ = body((x, H, f, g), None)
+        return (x, H, f, g, k + 1)
+
+    (x, _, f, _, _) = lax.while_loop(
+        w_cond, w_body, (val0, eye, f0, g0, jnp.asarray(0, jnp.int32))
+    )
     return x, f
 
 
 def _newton_single(
-    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None
+    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None,
+    g_tol: float = 0.0,
 ):
     """Newton + backtracking on a SINGLE masked constant (the reference's
     1-constant special case, /root/reference/src/ConstantOptimization.jl:22-41).
     Curvature via a Hessian-vector product along the masked direction.
+    ``g_tol``: stop when the projected gradient magnitude drops below it
+    (Optim.jl g_tol; 0 = legacy fixed-iteration behavior, see _bfgs_single).
     ``combine``: see _bfgs_single — applied outside grad/jvp (both are
-    linear maps of the per-shard pieces)."""
+    linear maps of the per-shard pieces); the gate reads the combined
+    gradient from the carry so the while condition runs no collective."""
     e = mask.astype(val0.dtype)
     if combine is None:
         combine = lambda x: x  # noqa: E731
@@ -125,21 +157,23 @@ def _newton_single(
     def fc(v):
         return combine(f(v))
 
-    def body(carry, _):
-        x, fx = carry
-        g = jnp.vdot(combine(jax.grad(f)(x)), e)
+    def proj_grad(v):
+        return jnp.vdot(combine(jax.grad(f)(v)), e)
+
+    def body(carry):
+        x, fx, g, k = carry
         h = jnp.vdot(combine(jax.jvp(jax.grad(f), (x,), (e,))[1]), e)
         step = jnp.where(jnp.abs(h) > 1e-30, -g / h, -g)
         step = jnp.where(jnp.isfinite(step), step, 0.0)
 
         def ls_cond(state):
-            alpha, f_new, k = state
-            return (~(f_new < fx)) & (k < 8)
+            alpha, f_new, k_ = state
+            return (~(f_new < fx)) & (k_ < 8)
 
         def ls_body(state):
-            alpha, _, k = state
+            alpha, _, k_ = state
             alpha = alpha * 0.5
-            return alpha, fc(x + alpha * step * e), k + 1
+            return alpha, fc(x + alpha * step * e), k_ + 1
 
         f_try = fc(x + step * e)
         alpha, f_new, _ = lax.while_loop(
@@ -147,18 +181,28 @@ def _newton_single(
         )
         ok = jnp.isfinite(f_new) & (f_new < fx)
         x_new = jnp.where(ok, x + alpha * step * e, x)
-        return (x_new, jnp.where(ok, f_new, fx)), None
+        return x_new, jnp.where(ok, f_new, fx), proj_grad(x_new), k + 1
+
+    def cond(carry):
+        x, fx, g, k = carry
+        return (k < iters) & ~(jnp.abs(g) < g_tol)
 
     f0 = fc(val0)
-    (x, fx), _ = lax.scan(body, (val0, f0), None, length=iters)
+    x, fx, _, _ = lax.while_loop(
+        cond, body, (val0, f0, proj_grad(val0), jnp.asarray(0, jnp.int32))
+    )
     return x, fx
 
 
 def _neldermead_single(
-    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None
+    loss_fn, val0, structure, X, y, w, has_w, mask, iters: int, combine=None,
+    g_tol: float = 0.0,
 ):
     """Masked Nelder–Mead simplex (the reference's configurable alternative,
     /root/reference/src/Options.jl:522-532). Non-constant slots stay pinned.
+    ``g_tol`` is accepted for signature parity but unused — the simplex is
+    derivative-free, so there is no gradient norm to gate on (Optim.jl's
+    NelderMead likewise ignores g_tol).
     ``combine``: see _bfgs_single (derivative-free, so values only)."""
     N = val0.shape[0]
     dtype = val0.dtype
@@ -253,16 +297,32 @@ def remat_tree_loss(opset, loss_elem, X, y, w, has_w, complex_n=None,
     return loss_fn
 
 
+def _clamped_chunk(
+    chunk: int, S_r: int, N_slots: int, R_rows: int, dtype, complex_vals: bool,
+    budget: float = 2e9,
+) -> int:
+    """Row-aware chunk clamp for the BFGS lax.map: each vmapped instance
+    holds ~[N_slots, R] rematerialized interpreter registers per restart.
+    The itemsize comes from the actual compute dtype (f64 doubles, complex
+    doubles again); a complex run driven through the real 2N view with a
+    non-complex dtype still pays the pair, hence the explicit x2."""
+    itemsize = np.dtype(dtype).itemsize
+    if complex_vals and np.dtype(dtype).kind != "c":
+        itemsize *= 2
+    per_instance = max(1, S_r * N_slots * R_rows * itemsize)
+    return max(1, min(chunk, int(budget // per_instance)))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "opset", "loss_elem", "iters", "has_w", "algorithm", "complex_vals",
-        "objective",
+        "objective", "g_tol",
     ),
 )
 def _optimize_batch(
     flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS",
-    complex_vals=False, objective=None,
+    complex_vals=False, objective=None, g_tol=0.0,
 ):
     """starts: [P, S, N] initial constant vectors (S = 1 + nrestarts).
     Returns best (val [P,N], loss [P]) over restarts per tree.
@@ -295,9 +355,13 @@ def _optimize_batch(
         one_const = jnp.sum(mask_p) == 1
 
         def per_restart(v0):
-            vm, fm = main(loss_fn, v0, struct_p, X, y, w, has_w, mask_p, iters)
+            vm, fm = main(
+                loss_fn, v0, struct_p, X, y, w, has_w, mask_p, iters,
+                g_tol=g_tol,
+            )
             vn, fn_ = _newton_single(
-                loss_fn, v0, struct_p, X, y, w, has_w, mask_p, iters
+                loss_fn, v0, struct_p, X, y, w, has_w, mask_p, iters,
+                g_tol=g_tol,
             )
             return (
                 jnp.where(one_const, vn, vm),
@@ -312,14 +376,12 @@ def _optimize_batch(
     structure = _Structure(*(jnp.asarray(a) for a in structure))
     P = starts.shape[0]
     chunk = int(os.environ.get("SR_CONSTOPT_CHUNK", 8))
-    # row-aware clamp: each vmapped instance holds ~[N_slots, R] remat'd
-    # interpreter registers per restart; keep a chunk under ~2GB so big-n
-    # unbatched runs degrade to smaller chunks instead of crashing the
-    # device (observed: worker crash at n=1M with chunk=8)
-    S_r = starts.shape[1]
-    R_rows = X.shape[-1]
-    per_instance = max(1, S_r * N_slots * R_rows * 4)
-    chunk = min(chunk, max(1, int(2e9 // per_instance)))
+    # row-aware clamp: keep a chunk under ~2GB so big-n unbatched runs
+    # degrade to smaller chunks instead of crashing the device (observed:
+    # worker crash at n=1M with chunk=8); see _clamped_chunk
+    chunk = _clamped_chunk(
+        chunk, starts.shape[1], N_slots, X.shape[-1], X.dtype, complex_vals
+    )
     chunk = max(1, min(chunk, P))
     # Pad the batch up to a chunk multiple (duplicating tree 0) rather than
     # shrinking the chunk to a divisor of P: shrink-to-divisor degrades to
@@ -530,27 +592,66 @@ def optimize_constants_batched(
         dev = next(iter(X.devices())) if hasattr(X, "devices") else None
         if dev is not None:
             to_dev = lambda a: jax.device_put(np.asarray(a), dev)  # noqa: E731
-    vals, fs = _optimize_batch(
-        FlatTrees(*(to_dev(a) for a in flat)),
-        X,
-        y,
-        w if has_w else to_dev(np.zeros((), np.empty(0, dtype).real.dtype)),
-        to_dev(base),
-        scorer.opset,
-        scorer.loss_elem,
-        iters,
-        has_w,
-        algorithm=options.optimizer_algorithm,
-        complex_vals=complex_vals,
-        objective=options.loss_function_jit,
-    )
-    vals = np.asarray(vals)
-    fs = np.asarray(fs, dtype=np.float64)
+    g_tol = float(options.optimizer_g_tol)
+    w_arg = w if has_w else to_dev(np.zeros((), np.empty(0, dtype).real.dtype))
 
-    # eval accounting: ~2 evals (value+grad) per iteration per restart
+    def run_batch(flat_b, starts_b):
+        return _optimize_batch(
+            FlatTrees(*(to_dev(a) for a in flat_b)),
+            X,
+            y,
+            w_arg,
+            to_dev(starts_b),
+            scorer.opset,
+            scorer.loss_elem,
+            iters,
+            has_w,
+            algorithm=options.optimizer_algorithm,
+            complex_vals=complex_vals,
+            objective=options.loss_function_jit,
+            g_tol=g_tol,
+        )
+
+    # length-bucketed dispatch: run the BFGS (and its remat'd scan loss) at
+    # each bucket's node count instead of the global max_nodes; per-bucket
+    # sub-batches re-pad to batch_bucket, keeping compiles O(buckets x log P).
+    # The restart jitter was drawn on the FULL [P, S, N] base above, so the
+    # trajectory is identical with bucketing on or off (pad slots are masked
+    # out of the update and contribute exact zeros to losses/gradients).
+    parts = length_buckets(np.asarray(flat.length), N)
+    if length_buckets_enabled() and not (len(parts) == 1 and parts[0][0] == N):
+        vals = np.array(flat.val, dtype=dtype)
+        fs = np.empty((P,), dtype=np.float64)
+        for n_b, sel in parts:
+            sub = FlatTrees(*(np.asarray(a)[sel] for a in flat))
+            if complex_vals:  # base is the real 2N view [..., real; imag]
+                sub_starts = np.concatenate(
+                    [base[sel][:, :, :n_b], base[sel][:, :, N:N + n_b]],
+                    axis=-1,
+                )
+            else:
+                sub_starts = base[sel][:, :, :n_b]
+            pad = batch_bucket(sel.size) - sel.size
+            if pad:
+                dup = lambda a: np.concatenate(  # noqa: E731
+                    [a, np.repeat(a[:1], pad, axis=0)]
+                )
+                sub = FlatTrees(*(dup(a) for a in sub))
+                sub_starts = dup(sub_starts)
+            vals_b, fs_b = run_batch(slice_nodes(sub, n_b), sub_starts)
+            vals[sel, :n_b] = np.asarray(vals_b)[: sel.size]
+            fs[sel] = np.asarray(fs_b, dtype=np.float64)[: sel.size]
+    else:
+        vals, fs = run_batch(flat, base)
+        vals = np.asarray(vals)
+        fs = np.asarray(fs, dtype=np.float64)
+
+    # eval accounting: ~2 evals (value+grad) per iteration per restart —
+    # using the f_calls_limit-CLAMPED iteration count actually run (with
+    # convergence gating this is an upper bound; early exits do less work)
     n_rows = scorer.dataset.n if idx is None else len(idx)
     with scorer._evals_lock:
-        scorer.num_evals += n_real * S * 2 * options.optimizer_iterations * (
+        scorer.num_evals += n_real * S * 2 * iters * (
             n_rows / scorer.dataset.n
         )
 

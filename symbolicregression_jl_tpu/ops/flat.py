@@ -30,6 +30,10 @@ __all__ = [
     "flatten_trees",
     "unflatten_tree",
     "pad_bucket",
+    "bucket_min",
+    "bucket_sizes",
+    "length_buckets",
+    "slice_nodes",
 ]
 
 KIND_PAD = 0
@@ -83,6 +87,82 @@ def batch_bucket(n: int, minimum: int = 16) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def length_buckets_enabled() -> bool:
+    """Env kill-switch for the length-bucketed interpreter dispatch
+    (``SR_LENGTH_BUCKETS=0`` restores single full-width programs — used by
+    the bit-identity tests and the bench A/B)."""
+    import os
+
+    return os.environ.get("SR_LENGTH_BUCKETS", "1") != "0"
+
+
+def bucket_min() -> int:
+    """Smallest node bucket (``SR_BUCKET_MIN``, default 16). The bucket
+    ladder trades compile count for scan length: every extra bucket is one
+    more compiled program per hot path (scoring, BFGS, engine switch
+    branches). The default keeps small-``max_nodes`` configs (<= 16 — the
+    common test/tuning sizes) on a SINGLE full-width program — identical to
+    the unbucketed seed — while big-maxsize searches still split; set
+    ``SR_BUCKET_MIN=8`` for the full ladder when the per-iteration runtime
+    dwarfs compiles (the committed engine-profile A/B does)."""
+    import os
+
+    return int(os.environ.get("SR_BUCKET_MIN", 16))
+
+
+def bucket_sizes(max_nodes: int, minimum: int | None = None) -> tuple[int, ...]:
+    """Node-count dispatch buckets for the interpreter hot paths: powers of
+    two from ``minimum`` (default ``bucket_min()``) up, capped by (and
+    always ending at) ``max_nodes`` — the node-axis analogue of
+    ``batch_bucket``'s policy, so a search compiles O(log N) scan lengths
+    instead of one per tree length."""
+    if minimum is None:
+        minimum = bucket_min()
+    sizes: list[int] = []
+    b = minimum
+    while b < max_nodes:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_nodes)
+    return tuple(sizes)
+
+
+def length_buckets(
+    lengths, max_nodes: int, minimum: int | None = None
+) -> list[tuple[int, np.ndarray]]:
+    """Partition a batch by tree length into the ``bucket_sizes`` families.
+
+    Returns ``[(n_b, row_indices)]`` with every row assigned to the smallest
+    bucket that holds it; empty buckets are dropped. Host-side numpy — the
+    caller slices the flat batch per bucket (``slice_nodes``) and dispatches
+    each group to the bucket-sized compiled program.
+    """
+    lengths = np.asarray(lengths)
+    out: list[tuple[int, np.ndarray]] = []
+    prev = 0
+    for n_b in bucket_sizes(max_nodes, minimum):
+        if prev == 0:
+            sel = np.nonzero(lengths <= n_b)[0]
+        else:
+            sel = np.nonzero((lengths > prev) & (lengths <= n_b))[0]
+        if sel.size:
+            out.append((n_b, sel))
+        prev = n_b
+    return out
+
+
+def slice_nodes(flat: FlatTrees, n: int) -> FlatTrees:
+    """Truncate the node axis to ``n`` slots. Valid whenever every row's
+    length is <= n: postorder children live at strictly smaller slots and
+    pad slots are never read, so evaluation (and its VJP) over the truncated
+    batch is bit-identical to the full-width program. Works on numpy and
+    traced arrays alike."""
+    return FlatTrees(
+        flat.kind[:, :n], flat.op[:, :n], flat.lhs[:, :n], flat.rhs[:, :n],
+        flat.feat[:, :n], flat.val[:, :n], flat.length,
+    )
 
 
 def flatten_trees(

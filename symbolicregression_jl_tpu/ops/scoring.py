@@ -21,7 +21,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .flat import FlatTrees
+from .flat import (
+    FlatTrees,
+    batch_bucket,
+    length_buckets,
+    length_buckets_enabled,
+    slice_nodes,
+)
 from .interp import eval_trees
 from .losses import weighted_mean_loss
 from .operators import OperatorSet
@@ -29,6 +35,7 @@ from .operators import OperatorSet
 __all__ = [
     "batched_loss",
     "batched_loss_jit",
+    "batched_loss_bucketed",
     "objective_loss_jit",
     "loss_to_score",
     "baseline_loss",
@@ -86,6 +93,66 @@ def batched_loss_jit(flat, X, y, weights, opset, loss_elem, use_pallas=False) ->
     # DEFAULT device, which breaks CPU-committed complex data on TPU hosts
     w = weights if has_weights else np.zeros((), X.dtype)
     return _batched_loss_jit(flat, X, y, w, opset, loss_elem, has_weights)
+
+
+def batched_loss_bucketed(
+    flat: FlatTrees,
+    X: jax.Array,
+    y: jax.Array,
+    weights: jax.Array | None,
+    opset: OperatorSet,
+    loss_elem: Callable,
+) -> Callable[[], np.ndarray]:
+    """Length-bucketed interpreter scoring over a HOST (numpy) flat batch.
+
+    Partitions the batch by tree length (``length_buckets``) and runs the
+    scan interpreter at each bucket's node count instead of the global
+    max_nodes — a 9-node tree in a maxsize-40 search pays a 16-slot scan,
+    not 40. Per-bucket sub-batches are padded to ``batch_bucket`` so the
+    compile-cache population stays O(buckets x log P). Losses are
+    bit-identical to the full-width program: pad slots write exact zeros and
+    are never read, and the loss reduction runs over the (unchanged) row
+    axis.
+
+    Returns a zero-arg materializer (all bucket programs are dispatched
+    asynchronously up front) yielding float [P] losses in input order.
+    """
+    lengths = np.asarray(flat.length)
+    P, N = flat.kind.shape
+    parts = length_buckets(lengths, N)
+    if not length_buckets_enabled() or (
+        len(parts) == 1 and parts[0][0] == N and P == batch_bucket(P)
+    ):
+        dev = batched_loss_jit(flat, X, y, weights, opset, loss_elem)
+        try:
+            dev.copy_to_host_async()
+        except Exception:
+            pass
+        return lambda: np.asarray(dev)[:P]
+
+    pending = []
+    for n_b, sel in parts:
+        sub = FlatTrees(*(np.asarray(a)[sel] for a in flat))
+        pad = batch_bucket(sel.size) - sel.size
+        if pad:
+            dup = lambda a: np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+            sub = FlatTrees(*(dup(a) for a in sub))
+        dev = batched_loss_jit(
+            slice_nodes(sub, n_b), X, y, weights, opset, loss_elem
+        )
+        try:
+            dev.copy_to_host_async()
+        except Exception:
+            pass
+        pending.append((sel, dev))
+
+    def materialize() -> np.ndarray:
+        out = np.empty((P,), dtype=np.float64)
+        for sel, dev in pending:
+            out[sel] = np.asarray(dev)[: sel.size]
+        return out
+
+    return materialize
 
 
 @functools.partial(

@@ -151,6 +151,11 @@ class Options:
     optimizer_nrestarts: int = 2
     optimizer_iterations: int = 8
     optimizer_f_calls_limit: int | None = None
+    # convergence gate for the batched BFGS/Newton inner loops: stop a tree's
+    # optimization as soon as the masked gradient's inf-norm drops below this
+    # (Optim.jl g_tol semantics, default 1e-8 like Optim's); 0 disables the
+    # gate and restores the fixed-iteration scan exactly
+    optimizer_g_tol: float = 1e-8
 
     # -- batching ------------------------------------------------------------
     batching: bool = False
@@ -277,6 +282,8 @@ class Options:
             raise ValueError("async_workers must be >= 1 (or None for auto)")
         if self.device_mutation_attempts < 1:
             raise ValueError("device_mutation_attempts must be >= 1")
+        if not (self.optimizer_g_tol >= 0.0):
+            raise ValueError("optimizer_g_tol must be >= 0 (0 disables the gate)")
         if self.optimizer_algorithm not in ("BFGS", "NelderMead"):
             raise ValueError(
                 f"unsupported optimizer_algorithm {self.optimizer_algorithm!r}; "

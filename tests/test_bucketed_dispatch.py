@@ -1,0 +1,288 @@
+"""Length-bucketed interpreter dispatch + convergence-gated const-opt.
+
+Pins the semantics contract of the bucketing layer (ops/flat.bucket_sizes /
+length_buckets / slice_nodes): truncating the node axis to any bucket that
+holds a batch's longest tree is BIT-identical for losses and gradients (pad
+slots write exact zeros and are never read by live slots; the loss reduction
+runs over the unchanged row axis), the compile-cache population stays
+O(buckets x log P), the convergence gate (Options.optimizer_g_tol) never
+degrades the accepted loss vs the fixed-iteration scan, and the two
+satellite bug fixes (clamped-iters eval accounting, itemsize-aware chunk
+clamp) stay fixed.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.dataset import Dataset
+from symbolicregression_jl_tpu.models.mutation_functions import (
+    gen_random_tree_fixed_size,
+)
+from symbolicregression_jl_tpu.models.scorer import BatchScorer
+from symbolicregression_jl_tpu.ops.constant_opt import (
+    _clamped_chunk,
+    optimize_constants_batched,
+)
+from symbolicregression_jl_tpu.ops.flat import (
+    bucket_sizes,
+    flatten_trees,
+    length_buckets,
+    slice_nodes,
+)
+from symbolicregression_jl_tpu.ops.interp import eval_grad_trees
+from symbolicregression_jl_tpu.ops.scoring import (
+    batched_loss_bucketed,
+    batched_loss_jit,
+)
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        maxsize=20,
+        save_to_file=False,
+        seed=0,
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _problem(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture
+def scorer():
+    X, y = _problem()
+    return BatchScorer(Dataset(X, y), _opts())
+
+
+def _varied_trees(options, n, seed):
+    """Trees whose node counts sweep every length bucket of max_nodes."""
+    rng = np.random.default_rng(seed)
+    N = options.max_nodes
+    return [
+        gen_random_tree_fixed_size(
+            1 + (k * (N - 1)) // max(1, n - 1), options.operators, 2, rng
+        )
+        for k in range(n)
+    ]
+
+
+def _const_trees(options, n=40, seed=0):
+    return [t for t in _varied_trees(options, n, seed) if t.has_constants()]
+
+
+# -- partition utilities ------------------------------------------------------
+
+
+def test_bucket_sizes_policy(monkeypatch):
+    # powers of two from the minimum up, always ending at max_nodes
+    assert bucket_sizes(24, minimum=8) == (8, 16, 24)
+    assert bucket_sizes(40, minimum=8) == (8, 16, 32, 40)
+    assert bucket_sizes(8, minimum=8) == (8,)
+    assert bucket_sizes(6, minimum=8) == (6,)
+    # O(log N) growth
+    assert len(bucket_sizes(1024, minimum=8)) == 8
+    # compile-friendly default minimum (16): small max_nodes configs stay on
+    # a single full-width program, exactly the unbucketed seed's program set
+    monkeypatch.delenv("SR_BUCKET_MIN", raising=False)
+    assert bucket_sizes(16) == (16,)
+    assert bucket_sizes(24) == (16, 24)
+    monkeypatch.setenv("SR_BUCKET_MIN", "8")
+    assert bucket_sizes(16) == (8, 16)
+
+
+def test_length_buckets_partition_covers_every_row():
+    lengths = np.array([1, 9, 17, 24, 3, 16, 8])
+    parts = length_buckets(lengths, 24, minimum=8)
+    seen = np.concatenate([sel for _, sel in parts])
+    assert sorted(seen.tolist()) == list(range(len(lengths)))
+    for n_b, sel in parts:
+        assert (lengths[sel] <= n_b).all()
+        # smallest bucket that holds the row
+        smaller = [b for b in bucket_sizes(24, minimum=8) if b < n_b]
+        if smaller:
+            assert (lengths[sel] > smaller[-1]).all()
+
+
+# -- bit-identity: scoring ----------------------------------------------------
+
+
+def test_bucketed_scoring_bit_identical(scorer):
+    options = scorer.options
+    trees = _varied_trees(options, 64, seed=3)
+    flat = flatten_trees(trees, options.max_nodes)
+    assert len(length_buckets(flat.length, options.max_nodes)) > 1
+    full = np.asarray(
+        batched_loss_jit(
+            flat, scorer.X, scorer.y, None, scorer.opset, scorer.loss_elem
+        )
+    )
+    bucketed = batched_loss_bucketed(
+        flat, scorer.X, scorer.y, None, scorer.opset, scorer.loss_elem
+    )()
+    assert np.array_equal(full, bucketed, equal_nan=True)
+
+
+def test_bucketed_gradients_bit_identical(scorer):
+    options = scorer.options
+    trees = _varied_trees(options, 32, seed=4)
+    flat = flatten_trees(trees, options.max_nodes)
+    N = options.max_nodes
+    full = np.asarray(eval_grad_trees(flat, scorer.X, scorer.opset))
+    for n_b, sel in length_buckets(flat.length, N):
+        from symbolicregression_jl_tpu.ops.flat import FlatTrees
+
+        sub = FlatTrees(*(np.asarray(a)[sel] for a in flat))
+        g = np.asarray(
+            eval_grad_trees(slice_nodes(sub, n_b), scorer.X, scorer.opset)
+        )
+        assert np.array_equal(g, full[sel][:, :n_b, :], equal_nan=True)
+
+
+# -- bit-identity: const-opt --------------------------------------------------
+
+
+def test_bucketed_const_opt_bit_identical(scorer, monkeypatch):
+    options = scorer.options
+    trees = _const_trees(options)
+    monkeypatch.setenv("SR_LENGTH_BUCKETS", "0")
+    t0, l0, i0 = optimize_constants_batched(
+        [t.copy() for t in trees], scorer, options, np.random.default_rng(1)
+    )
+    monkeypatch.setenv("SR_LENGTH_BUCKETS", "1")
+    t1, l1, i1 = optimize_constants_batched(
+        [t.copy() for t in trees], scorer, options, np.random.default_rng(1)
+    )
+    assert np.array_equal(l0, l1)
+    assert np.array_equal(i0, i1)
+    for a, b in zip(t0, t1):
+        assert np.array_equal(a.get_constants(), b.get_constants())
+
+
+def test_convergence_gate_never_degrades(scorer):
+    # gated (g_tol=1e-8) accepted losses must never exceed the
+    # fixed-iteration scan's (g_tol=0), and both obey accept-if-improved
+    options = _opts(optimizer_g_tol=1e-8)
+    fixed_options = _opts(optimizer_g_tol=0.0)
+    trees = _const_trees(options)
+    orig = scorer.loss_many([t.copy() for t in trees])
+    _, l_gated, _ = optimize_constants_batched(
+        [t.copy() for t in trees], scorer, options, np.random.default_rng(1)
+    )
+    _, l_fixed, _ = optimize_constants_batched(
+        [t.copy() for t in trees], scorer, fixed_options,
+        np.random.default_rng(1),
+    )
+    finite = np.isfinite(orig)
+    assert (l_gated[finite] <= orig[finite] + 1e-6).all()
+    assert (l_gated <= l_fixed + 1e-6 * np.maximum(1.0, np.abs(l_fixed))).all()
+
+
+def test_g_tol_validation():
+    with pytest.raises(ValueError, match="optimizer_g_tol"):
+        _opts(optimizer_g_tol=-1.0)
+
+
+# -- compile-count bound ------------------------------------------------------
+
+
+def test_compile_count_bounded(scorer):
+    import jax
+
+    from symbolicregression_jl_tpu.ops.scoring import _batched_loss_jit
+
+    jax.clear_caches()
+    options = scorer.options
+    n_buckets = len(bucket_sizes(options.max_nodes))
+    batch_sizes = (10, 33, 70)
+    for i, P in enumerate(batch_sizes):
+        trees = _varied_trees(options, P, seed=5 + i)
+        flat = flatten_trees(trees, options.max_nodes)
+        batched_loss_bucketed(
+            flat, scorer.X, scorer.y, None, scorer.opset, scorer.loss_elem
+        )()
+    # each (node bucket, power-of-two batch bucket) pair compiles at most
+    # once: O(buckets x log P), never one program per (length, batch) pair
+    bound = n_buckets * (len(batch_sizes) + 1)
+    assert _batched_loss_jit._cache_size() <= bound
+
+
+# -- satellite regressions ----------------------------------------------------
+
+
+def test_eval_accounting_uses_clamped_iters(scorer):
+    # optimizer_f_calls_limit clamps the iteration count actually run;
+    # num_evals must use the clamped value, not the raw optimizer_iterations
+    options = _opts(optimizer_iterations=8, optimizer_f_calls_limit=12)
+    trees = _const_trees(options, n=20)
+    S = 1 + options.optimizer_nrestarts
+    iters_clamped = max(1, min(8, 12 // (4 * S)))
+    assert iters_clamped < options.optimizer_iterations  # the fix is live
+    before = scorer.num_evals
+    optimize_constants_batched(
+        [t.copy() for t in trees], scorer, options, np.random.default_rng(1)
+    )
+    spent = scorer.num_evals - before
+    # loss_many inside optimize_constants_batched adds len(trees) evals for
+    # the original-loss comparison
+    expected = len(trees) * S * 2 * iters_clamped + len(trees)
+    assert spent == pytest.approx(expected)
+
+
+def test_chunk_clamp_is_itemsize_aware():
+    # per-instance live memory scales with the element size: f64 halves the
+    # admissible chunk vs f32, complex128 quarters it
+    kw = dict(chunk=1 << 30, S_r=3, N_slots=24, R_rows=10_000)
+    c32 = _clamped_chunk(dtype=np.float32, complex_vals=False, **kw)
+    c64 = _clamped_chunk(dtype=np.float64, complex_vals=False, **kw)
+    cc64 = _clamped_chunk(dtype=np.complex64, complex_vals=True, **kw)
+    cc128 = _clamped_chunk(dtype=np.complex128, complex_vals=True, **kw)
+    assert c32 == int(2e9 // (3 * 24 * 10_000 * 4))
+    assert c64 == c32 // 2
+    assert cc64 == c32 // 2  # complex64 = two f32s
+    assert cc128 == c32 // 4
+    # a complex run driven through a real-typed 2N view still pays the pair
+    assert _clamped_chunk(dtype=np.float32, complex_vals=True, **kw) == c32 // 2
+    # floor at 1 — never a zero chunk
+    assert (
+        _clamped_chunk(8, 3, 24, 10_000_000_000, np.float64, False) == 1
+    )
+
+
+# -- device engine ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_engine_compaction_and_gating_bit_identical(monkeypatch):
+    """The engine's length compaction (sort + per-chunk bucket switch) must
+    not change results: per-lane while_loops freeze converged lanes and the
+    truncated scan is exact, so SR_NO_COPT_COMPACT on/off is bit-identical.
+    (slow: two device-engine compiles for one equality check)"""
+    from symbolicregression_jl_tpu import equation_search
+
+    X, y = _problem(n=100)
+    monkeypatch.setenv("SR_BUCKET_MIN", "8")  # multi-bucket at max_nodes=16
+
+    def run():
+        options = _opts(
+            populations=2,
+            population_size=12,
+            ncycles_per_iteration=20,
+            maxsize=14,
+            scheduler="device",
+        )
+        res = equation_search(X, y, options=options, niterations=1, verbosity=0)
+        return min(m.loss for m in res.pareto_frontier)
+
+    monkeypatch.delenv("SR_NO_COPT_COMPACT", raising=False)
+    base = run()
+    monkeypatch.setenv("SR_NO_COPT_COMPACT", "1")
+    no_compact = run()
+    assert base == no_compact
