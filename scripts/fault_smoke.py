@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Fault-injection CI smoke (tiny config, CPU backend).
+
+Two end-to-end cycles through the fault-tolerant runtime, minutes not hours:
+
+1. **Checkpoint/resume**: a serial search is preempted (injected
+   ``peer_death``) at iteration 2 of 4 with a snapshot after every
+   iteration; ``resume_from`` must reproduce the uninterrupted run's hall
+   of fame bit-exactly.
+2. **Degraded exchange**: two processes joined by ``jax.distributed`` run
+   the device engine; an injected ``exchange_timeout`` at the same
+   allgather on both sides partitions them. Under
+   ``on_peer_loss="continue"`` each side must record the other dead and
+   COMPLETE its search solo instead of raising.
+
+Exits nonzero on the first violated invariant. Usage: python
+scripts/fault_smoke.py (CI passes no args; JAX_PLATFORMS=cpu is forced).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _frontier(res, options):
+    return ";".join(
+        f"{m.get_complexity(options)}:{m.loss:.17g}"
+        for m in sorted(
+            res.hall_of_fame.pareto_frontier(),
+            key=lambda m: m.get_complexity(options),
+        )
+    )
+
+
+def smoke_checkpoint_resume() -> None:
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.utils import faults
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as d:
+        def opts(**kw):
+            base = dict(
+                binary_operators=["+", "-", "*"],
+                unary_operators=["cos"],
+                populations=2, population_size=12,
+                ncycles_per_iteration=8, maxsize=12, seed=0,
+                scheduler="lockstep", save_to_file=False,
+                checkpoint_file=os.path.join(d, "ck.pkl"),
+            )
+            base.update(kw)
+            return Options(**base)
+
+        full = equation_search(X, y, options=opts(), niterations=4, verbosity=0)
+        try:
+            equation_search(
+                X, y,
+                options=opts(
+                    checkpoint_every=1, fault_spec="peer_death@2:mode=raise"
+                ),
+                niterations=4, verbosity=0,
+            )
+            raise SystemExit("FAIL: injected peer_death did not fire")
+        except faults.FaultInjected:
+            pass
+        resumed = equation_search(
+            X, y, options=opts(), niterations=4, verbosity=0,
+            resume_from=os.path.join(d, "ck.pkl"),
+        )
+        o = opts()
+        if _frontier(resumed, o) != _frontier(full, o):
+            raise SystemExit(
+                "FAIL: resumed hall of fame differs from the uninterrupted "
+                f"run\n  full:    {_frontier(full, o)}"
+                f"\n  resumed: {_frontier(resumed, o)}"
+            )
+    print("OK checkpoint/resume: bit-exact after injected preemption")
+
+
+_EXCHANGE_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+from symbolicregression_jl_tpu.parallel.distributed import initialize
+initialize(coordinator_address="localhost:{port}", num_processes=2, process_id=pid)
+
+import numpy as np
+from symbolicregression_jl_tpu import Options, equation_search
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2, 64)).astype(np.float32)
+y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+# the SAME injected exchange_timeout on both sides partitions the pair at
+# one allgather: each side drops the other immediately (no deadline wait)
+# and must finish its remaining iterations solo
+options = Options(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    populations=2, population_size=12,
+    ncycles_per_iteration=8, maxsize=12, seed=0,
+    scheduler="device", save_to_file=False,
+    on_peer_loss="continue",
+    fault_spec="exchange_timeout@1",
+)
+res = equation_search(X, y, options=options, niterations=3, verbosity=0)
+from symbolicregression_jl_tpu.parallel import distributed as dist
+best = min(m.loss for m in res.pareto_frontier)
+print(f"RESULT p{{pid}} best={{best:.6g}} dead={{sorted(dist.dead_peers())}}",
+      flush=True)
+"""
+
+
+def smoke_degraded_exchange() -> None:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    with tempfile.TemporaryDirectory() as d:
+        script = os.path.join(d, "worker.py")
+        with open(script, "w") as f:
+            f.write(_EXCHANGE_WORKER.format(repo=REPO, port=port))
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)  # each worker keeps 1 CPU device
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=REPO,
+            )
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=780)[0] for p in procs]
+
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise SystemExit(
+                f"FAIL: process {i} did not survive the injected "
+                f"exchange timeout (rc={p.returncode}):\n{out}"
+            )
+        other = 1 - i
+        line = next(
+            (l for l in out.splitlines() if l.startswith(f"RESULT p{i}")), ""
+        )
+        if f"dead=[{other}]" not in line:
+            raise SystemExit(
+                f"FAIL: process {i} never recorded peer {other} dead:\n{out}"
+            )
+    print("OK degraded exchange: both partitions completed solo")
+
+
+if __name__ == "__main__":
+    smoke_checkpoint_resume()
+    smoke_degraded_exchange()
+    print("FAULT_SMOKE=pass")

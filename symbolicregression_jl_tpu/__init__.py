@@ -52,7 +52,14 @@ from .ops.losses import (
     SmoothedL1HingeLoss,
     ZeroOneLoss,
 )
-from .utils.checkpoint import load_saved_state
+from .parallel.distributed import PeerLossError
+from .utils.checkpoint import (
+    SearchCheckpoint,
+    SearchCheckpointer,
+    latest_checkpoint,
+    load_checkpoint,
+    load_saved_state,
+)
 
 __version__ = "0.1.0"
 
@@ -78,6 +85,11 @@ __all__ = [
     "flatten_trees",
     "resolve_operators",
     "load_saved_state",
+    "SearchCheckpoint",
+    "SearchCheckpointer",
+    "latest_checkpoint",
+    "load_checkpoint",
+    "PeerLossError",
     "DWDMarginLoss",
     "ExpLoss",
     "HuberLoss",

@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+import warnings
 
 import numpy as np
 
@@ -1470,6 +1471,50 @@ def _simplified_frontier_pool(members, options, cfg: EvoConfig, score_call, hof)
     return pool, len(trees)
 
 
+def _decode_state_populations(state, I, P, cfg, options):
+    """Decode the live EvoState into host Populations — ONE full D2H readback.
+
+    Shared by the final population decode and the in-loop checkpoint writer
+    (the state reference is always the latest output buffers, so this is
+    valid even with donated/pipelined iteration executables). Returns
+    ``(pops, slots, arrays)``: ``slots`` is ``(island, member, mapped
+    complexity)`` per live member and ``arrays`` the decoded
+    ``(kind, op, lhs, rhs, feat, val, length, loss, score)`` tuple so the
+    final multi-host sync can reuse the buffers instead of re-reading."""
+    kind = np.asarray(state.kind)
+    opa = np.asarray(state.op)
+    lhs = np.asarray(state.lhs)
+    rhs = np.asarray(state.rhs)
+    feat = np.asarray(state.feat)
+    val = np.asarray(state.val)
+    length = np.asarray(state.length)
+    loss = np.asarray(state.loss).astype(np.float64)
+    score = np.asarray(state.score).astype(np.float64)
+    pops = []
+    slots = []
+    for i in range(I):
+        flat_i = FlatTrees(
+            kind[i], opa[i], lhs[i], rhs[i], feat[i], val[i], length[i]
+        )
+        members = []
+        for p in range(P):
+            if length[i, p] < 1:
+                continue
+            tree = unflatten_tree(flat_i, p)
+            m = PopMember(
+                tree, float(score[i, p]), float(loss[i, p]),
+                # node count sans mapping; None -> get_complexity computes
+                # the mapped value lazily with Options.complexity_mapping
+                complexity=(
+                    int(length[i, p]) if cfg.complexity_table is None else None
+                ),
+            )
+            members.append(m)
+            slots.append((i, p, m.get_complexity(options)))
+        pops.append(Population(members))
+    return pops, slots, (kind, opa, lhs, rhs, feat, val, length, loss, score)
+
+
 def device_search_one_output(
     dataset: Dataset,
     options: Options,
@@ -1481,6 +1526,7 @@ def device_search_one_output(
     stdin_reader=None,
     recorder=None,
     out_j: int = 1,
+    checkpoint_base: str | None = None,
 ):
     """Run one output's search on the device engine. Returns SearchResult
     (same contract as models/../search._search_one_output)."""
@@ -1488,6 +1534,12 @@ def device_search_one_output(
     import jax.numpy as jnp
 
     from ..search import SearchResult  # late import (module cycle)
+    from ..utils import faults
+    from ..utils.checkpoint import (
+        SearchCheckpoint,
+        SearchCheckpointer,
+        options_fingerprint,
+    )
     from ..utils.export_csv import save_hall_of_fame
 
     reason = device_mode_supported(options)
@@ -1534,6 +1586,20 @@ def device_search_one_output(
         I = isl_stop - isl_start
         # decorrelate this process's initial populations and engine RNG
         rng = np.random.default_rng([int(rng.integers(0, 2**31 - 1)), proc_id])
+    injector = (
+        faults.install(options.fault_spec)
+        if options.fault_spec
+        else faults.active()
+    )
+    ckptr = None
+    if checkpoint_base:
+        # per-process snapshot files in multi-host mode: each process owns
+        # only its island slice, so snapshots cannot merge into one file —
+        # equation_search(resume_from=...) falls back to the .p{id} file
+        ckptr = SearchCheckpointer.from_options(
+            options,
+            f"{checkpoint_base}.p{proc_id}" if multi_host else checkpoint_base,
+        )
     N = options.max_nodes
     eng_dt = np.dtype(options.dtype)  # f32 or f64 (device_mode_supported)
     if eng_dt == np.float64:
@@ -1981,7 +2047,13 @@ def device_search_one_output(
         stdin_reader = StdinReader()
     start_time = time.time()
     stop_reason = None
-    num_evals = 0.0
+    # eval totals span the whole lineage (checkpoint / .meta.json sidecar)
+    base_evals = (
+        float(getattr(saved_state, "num_evals", 0.0) or 0.0)
+        if saved_state is not None
+        else 0.0
+    )
+    num_evals = base_evals
     host_evals = 0.0  # simplify-rescore evals (host-triggered, device-run)
     do_simplify = (
         options.should_simplify
@@ -1997,8 +2069,35 @@ def device_search_one_output(
     # the double-buffered exchange slot (multi-host)
     pending_rb = None
     exchange = (
-        dist.DoubleBufferedExchange() if (multi_host and async_rb) else None
+        dist.DoubleBufferedExchange(on_peer_loss=options.on_peer_loss)
+        if (multi_host and async_rb)
+        else None
     )
+    known_dead = set(dist.dead_peers())
+
+    def _note_lost_peers():
+        """Degraded-mode bookkeeping (on_peer_loss="continue"): name newly
+        lost processes and re-derive this process's share of the global
+        island space so logs agree on the shrunken world. The on-device
+        islands themselves are untouched — survivors keep searching their
+        slice with a one-iteration-stale migration pool."""
+        lost = set(dist.dead_peers()) - known_dead
+        if not lost:
+            return
+        known_dead.update(lost)
+        live = dist.live_process_ids()
+        try:
+            s0, s1 = dist.process_island_slice(
+                options.populations, live=live
+            )
+            span = f"; this process now covers island slice [{s0}, {s1})"
+        except ValueError:
+            span = ""
+        warnings.warn(
+            f"peer process(es) {sorted(lost)} lost mid-search; continuing "
+            f"on {len(live)} survivor(s) with a one-iteration-stale "
+            f"migration pool{span}"
+        )
 
     def _consume_readback(gathered, buf, it_label):
         """Fold one iteration's packed readback — and, multi-host, the
@@ -2011,9 +2110,12 @@ def device_search_one_output(
         nonlocal state, host_evals, device_evals
         if multi_host:
             with prof.stage("decode_hof"):
+                # one row per SURVIVING process (degraded mode shrinks the
+                # gather), so iterate rows — never the launch-time n_proc
+                g0 = np.asarray(gathered[0])
                 decoded = [
-                    _decode_readback(np.asarray(gathered[0][pi]), cfg)
-                    for pi in range(n_proc)
+                    _decode_readback(np.asarray(g0[pi]), cfg)
+                    for pi in range(g0.shape[0])
                 ]
                 device_evals = sum(d[4] for d in decoded)
                 decoded_members = []
@@ -2097,6 +2199,9 @@ def device_search_one_output(
             )
 
     for it in range(niterations):
+        # simulated preemption (fault-injection harness); counts one call
+        # per iteration on every process that carries the spec
+        injector.maybe_die("peer_death")
         with prof.stage("evolve"):
             state = run_step(state, score_data)
             if replay is not None:
@@ -2144,6 +2249,7 @@ def device_search_one_output(
                 a.copy_to_host_async()
             if multi_host:
                 gathered = exchange.roll((rb, *pool_dev))
+                _note_lost_peers()
                 if gathered is not None:
                     _consume_readback(gathered, None, it)
             else:
@@ -2156,7 +2262,10 @@ def device_search_one_output(
             with prof.stage("readback_d2h"):
                 payload = tuple(np.asarray(a) for a in (rb, *pool_dev))
             with prof.stage("exchange"):
-                gathered = dist.all_gather_migration_pool(payload)
+                gathered = dist.all_gather_migration_pool(
+                    payload, on_peer_loss=options.on_peer_loss
+                )
+            _note_lost_peers()
             _consume_readback(gathered, None, it + 1)
         else:
             with prof.stage("readback_d2h"):
@@ -2166,10 +2275,37 @@ def device_search_one_output(
         # count AFTER the iteration's host-triggered rescore/simplify evals so
         # the max_evals stop and the returned total see them immediately (in
         # the pipelined loop both lag one iteration, like the readback)
-        num_evals = device_evals + host_evals
+        num_evals = base_evals + device_evals + host_evals
 
         if output_file and options.save_to_file and head:
-            save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+            save_hall_of_fame(
+                output_file, hof, options, dataset.variable_names,
+                num_evals=num_evals,
+            )
+        if ckptr is not None and ckptr.due(it + 1):
+            # best-effort snapshot (exact=False): decode the LIVE state (the
+            # state reference is always the latest output buffers, valid
+            # under donation) — resume rescore-warm-starts from it. In the
+            # pipelined loop hof/num_evals lag one iteration, matching the
+            # documented staleness of every other consumer here.
+            with prof.stage("checkpoint"):
+                ck_pops, _, _ = _decode_state_populations(
+                    state, I, P, cfg, options
+                )
+                ckptr.save(
+                    SearchCheckpoint(
+                        iteration=it + 1,
+                        niterations=niterations,
+                        scheduler="device",
+                        exact=False,
+                        populations=ck_pops,
+                        hall_of_fame=hof.copy(),
+                        num_evals=float(num_evals),
+                        options_fingerprint=options_fingerprint(options),
+                        wall_time=time.time() - start_time,
+                        out_j=out_j,
+                    )
+                )
         if verbosity > 0 and head:
             elapsed = time.time() - start_time
             print(
@@ -2208,10 +2344,12 @@ def device_search_one_output(
                 stop_code = int(
                     np.max(
                         dist.all_gather_migration_pool(
-                            np.asarray([stop_code], np.int32)
+                            np.asarray([stop_code], np.int32),
+                            on_peer_loss=options.on_peer_loss,
                         )
                     )
                 )
+            _note_lost_peers()
         prof.next_iteration()
         if stop_code:
             stop_reason = {
@@ -2225,56 +2363,27 @@ def device_search_one_output(
         # same iteration (lockstep stop), so the final gather stays uniform.
         if multi_host:
             gathered = exchange.flush()
+            _note_lost_peers()
             if gathered is not None:
                 _consume_readback(gathered, None, niterations)
         elif pending_rb is not None:
             _consume_readback(None, np.asarray(pending_rb), niterations)
-        num_evals = device_evals + host_evals
+        num_evals = base_evals + device_evals + host_evals
 
     iteration_seconds = time.time() - start_time
     if own_stdin:
         stdin_reader.close()
 
     # --- final population readback (host Populations for warm starts) -------
-    def np_at(a):
-        return np.asarray(a)
-
-    kind = np_at(state.kind)
-    opa = np_at(state.op)
-    lhs = np_at(state.lhs)
-    rhs = np_at(state.rhs)
-    feat = np_at(state.feat)
-    val = np_at(state.val)
-    length = np_at(state.length)
-    loss = np_at(state.loss).astype(np.float64)
-    score = np_at(state.score).astype(np.float64)
-    pops = []
-    final_slots = []
-    for i in range(I):
-        flat_i = FlatTrees(
-            kind[i], opa[i], lhs[i], rhs[i], feat[i], val[i], length[i]
-        )
-        members = []
-        for p in range(P):
-            if length[i, p] < 1:
-                continue
-            tree = unflatten_tree(flat_i, p)
-            m = PopMember(
-                tree, float(score[i, p]), float(loss[i, p]),
-                # node count sans mapping; None -> get_complexity computes
-                # the mapped value lazily with Options.complexity_mapping
-                complexity=(
-                    int(length[i, p]) if cfg.complexity_table is None else None
-                ),
-            )
-            members.append(m)
-            if multi_host:
-                # deferred: lockstep sync below (carry the MAPPED complexity
-                # so the exchange bins match hof slots under complexity_of_*)
-                final_slots.append((i, p, m.get_complexity(options)))
-            else:
-                hof.update(m, options)
-        pops.append(Population(members))
+    pops, final_slots, (
+        kind, opa, lhs, rhs, feat, val, length, loss, score
+    ) = _decode_state_populations(state, I, P, cfg, options)
+    if not multi_host:
+        for pop in pops:
+            hof.update_many(pop.members, options)
+    # multi-host defers to the lockstep sync below (final_slots carries the
+    # MAPPED complexity so the exchange bins match hof slots under
+    # complexity_of_*)
 
     if multi_host:
         # final lockstep hof sync: the last const-opt's improvements live
@@ -2297,8 +2406,11 @@ def device_search_one_output(
                     ffields, (kind, opa, lhs, rhs, feat, val)
                 ):
                     arr[s] = src[i, p]
-        g = dist.all_gather_migration_pool((fl, fn_, *ffields))
-        for pi in range(n_proc):
+        g = dist.all_gather_migration_pool(
+            (fl, fn_, *ffields), on_peer_loss=options.on_peer_loss
+        )
+        _note_lost_peers()
+        for pi in range(np.asarray(g[0]).shape[0]):
             bl = np.asarray(g[0][pi])
             bn = np.asarray(g[1][pi]).astype(np.int32)
             flds = [np.asarray(g[2 + j][pi]) for j in range(6)]
@@ -2312,7 +2424,10 @@ def device_search_one_output(
     # the hall of fame, and the returned frontier must match the saved file —
     # load_saved_state round-trips depend on it
     if output_file and options.save_to_file and head:
-        save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+        save_hall_of_fame(
+            output_file, hof, options, dataset.variable_names,
+            num_evals=num_evals,
+        )
 
     result = SearchResult(
         hall_of_fame=hof,

@@ -7,17 +7,50 @@ tree replacement), and ref/parent lineage ids for the recorder.
 
 from __future__ import annotations
 
-import itertools
+import threading
 
 import numpy as np
 
 from ..complexity import compute_complexity
 from ..tree import Node
 
-__all__ = ["PopMember", "generate_reference"]
+__all__ = [
+    "PopMember",
+    "generate_reference",
+    "counter_state",
+    "restore_counter_state",
+]
 
-_ref_counter = itertools.count(1)
-_birth_counter = itertools.count(1)
+
+class _Counter:
+    """Monotone id source. Thread-safe (the async island scheduler creates
+    members from worker threads) and — unlike itertools.count — queryable and
+    settable, which SearchCheckpointer needs: birth order drives
+    ``Population.oldest_index`` replacement, so a bit-exact resume must
+    restore the counters along with the populations."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self, start: int = 1):
+        self._value = start
+        self._lock = threading.Lock()
+
+    def __next__(self) -> int:
+        with self._lock:
+            v = self._value
+            self._value = v + 1
+        return v
+
+    def peek(self) -> int:
+        return self._value
+
+    def set(self, value: int) -> None:
+        with self._lock:
+            self._value = int(value)
+
+
+_ref_counter = _Counter()
+_birth_counter = _Counter()
 
 
 def generate_reference() -> int:
@@ -29,6 +62,18 @@ def next_birth() -> int:
     time in non-deterministic mode (/root/reference/src/Utils.jl:7-19); a
     counter gives identical ordering semantics and is always deterministic."""
     return next(_birth_counter)
+
+
+def counter_state() -> tuple[int, int]:
+    """(next ref, next birth) — captured by full-state checkpoints."""
+    return (_ref_counter.peek(), _birth_counter.peek())
+
+
+def restore_counter_state(state) -> None:
+    """Restore the counters from ``counter_state()`` (bit-exact resume)."""
+    ref, birth = state
+    _ref_counter.set(ref)
+    _birth_counter.set(birth)
 
 
 class PopMember:

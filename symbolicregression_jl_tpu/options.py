@@ -236,6 +236,30 @@ class Options:
     # (rejected with use_recorder, which needs lockstep replay).
     async_readback: bool | None = None
 
+    # -- fault tolerance ------------------------------------------------------
+    # full-state checkpoint cadence: every N iterations and/or every S
+    # wall-clock seconds (either alone enables checkpointing; both None
+    # disables it). Snapshots persist populations, hall of fame, RNG state,
+    # adaptive-parsimony frequencies, and num_evals, written atomically
+    # (tmp + os.replace) as {checkpoint_file}.{seq:06d} with a rolling
+    # window of checkpoint_keep files. equation_search(resume_from=...)
+    # restores the newest snapshot: bit-exact continuation on the serial
+    # (lockstep) scheduler, rescored warm start on device/async.
+    checkpoint_every: int | None = None
+    checkpoint_every_seconds: float | None = None
+    checkpoint_file: str | None = None  # base path; default "sr_checkpoint.pkl"
+    checkpoint_keep: int = 3
+    # multi-host exchange peer-loss policy: "raise" surfaces a PeerLossError
+    # naming the allgather sequence id and the missing process(es);
+    # "continue" marks them dead, re-derives the live island slice, and
+    # keeps searching on the survivors with a one-iteration-stale pool.
+    # Graceful degradation applies to the KV-store transport; the XLA
+    # collective path aborts with the runtime regardless.
+    on_peer_loss: str = "raise"
+    # deterministic fault injection (utils/faults.py) — same grammar as the
+    # SR_FAULT_SPEC env var, e.g. "nan_flood@2:frac=0.9;ckpt_crash@1".
+    fault_spec: str | None = None
+
     # -- derived (filled in __post_init__) -----------------------------------
     operators: OperatorSet = dataclasses.field(init=False)
     loss: Callable = dataclasses.field(init=False)
@@ -302,6 +326,28 @@ class Options:
                 "(stage fencing serializes the pipeline the async path "
                 "exists to overlap); leave async_readback=None for auto"
             )
+        if self.on_peer_loss not in ("raise", "continue"):
+            raise ValueError(
+                f"on_peer_loss must be 'raise' or 'continue', got "
+                f"{self.on_peer_loss!r}"
+            )
+        if self.checkpoint_every is not None and self.checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1 (or None to disable)")
+        if (
+            self.checkpoint_every_seconds is not None
+            and not self.checkpoint_every_seconds > 0
+        ):
+            raise ValueError(
+                "checkpoint_every_seconds must be > 0 (or None to disable)"
+            )
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
+        if self.fault_spec:
+            # validate the grammar eagerly — a typo'd spec that never fires
+            # would silently test nothing
+            from .utils.faults import parse_fault_spec
+
+            parse_fault_spec(self.fault_spec)
         if self.use_recorder and self.crossover_probability > 0:
             # recorder lineage is single-parent; same constraint as the
             # reference (/root/reference/src/RegularizedEvolution.jl:26-28)

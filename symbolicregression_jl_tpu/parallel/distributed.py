@@ -28,6 +28,8 @@ works unchanged.
 from __future__ import annotations
 
 import os
+import time
+import warnings
 
 import numpy as np
 
@@ -38,6 +40,11 @@ __all__ = [
     "all_gather_migration_pool",
     "allgather_transport",
     "DoubleBufferedExchange",
+    "PeerLossError",
+    "kv_timeout_ms",
+    "dead_peers",
+    "live_process_ids",
+    "reset_peer_state",
 ]
 
 
@@ -69,26 +76,82 @@ def is_distributed() -> bool:
     return jax.process_count() > 1
 
 
-def process_island_slice(n_islands: int) -> tuple[int, int]:
+def process_island_slice(
+    n_islands: int, live: list[int] | None = None
+) -> tuple[int, int]:
     """[start, stop) of the island axis owned by this process — the
     multi-host analogue of the reference's WorkerAssignments
     (/root/reference/src/SearchUtils.jl:62-86), but static: islands are
-    evenly striped across processes."""
+    evenly striped across processes. With ``live`` (graceful degradation /
+    resume after a peer loss), the islands re-stripe across the surviving
+    processes only — each survivor re-derives its logical ownership of the
+    full island axis without the dead peers."""
     import jax
 
     p = jax.process_index()
     n = jax.process_count()
+    if live is not None:
+        members = sorted(int(q) for q in live)
+        if p not in members:
+            raise ValueError(f"process {p} is not in the live set {members}")
+        rank, n = members.index(p), len(members)
+    else:
+        rank = p
     per = -(-n_islands // n)
-    start = min(p * per, n_islands)
+    start = min(rank * per, n_islands)
     stop = min(start + per, n_islands)
     return start, stop
 
 
 _KV_SEQ = 0
-_KV_TIMEOUT_MS = 600_000
+_KV_DEFAULT_TIMEOUT_MS = 600_000
+# processes that failed a KV exchange deadline under on_peer_loss="continue";
+# every later gather/barrier excludes them
+_DEAD_PEERS: set[int] = set()
 
 
-def _kv_allgather(arrays):
+def kv_timeout_ms() -> int:
+    """Allgather + barrier deadline in ms. ``SR_KV_TIMEOUT_MS`` overrides the
+    600000 default — the fault-injection rigs drop it to seconds so injected
+    peer loss is detected fast."""
+    try:
+        return int(os.environ.get("SR_KV_TIMEOUT_MS", _KV_DEFAULT_TIMEOUT_MS))
+    except ValueError:
+        return _KV_DEFAULT_TIMEOUT_MS
+
+
+class PeerLossError(RuntimeError):
+    """A peer failed to post its exchange payload before the deadline.
+    Carries the allgather sequence id and the missing process ids."""
+
+    def __init__(self, seq: int, missing, timeout_ms: int):
+        self.seq = int(seq)
+        self.missing = tuple(sorted(int(p) for p in missing))
+        peers = ", ".join(str(p) for p in self.missing)
+        super().__init__(
+            f"allgather seq {self.seq}: process(es) {peers} failed to post "
+            f"within {timeout_ms} ms (SR_KV_TIMEOUT_MS); set "
+            "on_peer_loss='continue' to keep searching on the survivors"
+        )
+
+
+def dead_peers() -> frozenset[int]:
+    """Processes dropped from the exchange so far (on_peer_loss='continue')."""
+    return frozenset(_DEAD_PEERS)
+
+
+def live_process_ids() -> list[int]:
+    import jax
+
+    return [p for p in range(jax.process_count()) if p not in _DEAD_PEERS]
+
+
+def reset_peer_state() -> None:
+    """Forget recorded peer deaths (test hook)."""
+    _DEAD_PEERS.clear()
+
+
+def _kv_allgather(arrays, on_peer_loss: str = "raise"):
     """Host-side allgather over the coordination service's key-value store.
 
     jax's CPU backend cannot execute multi-process XLA computations (the
@@ -98,33 +161,107 @@ def _kv_allgather(arrays):
     serialized leaves under a sequence-numbered key, blocking-reads every
     peer's, then a barrier + self-delete reclaims coordinator memory. The
     call sequence is lockstep on every process (the engine loop guarantees
-    it), so sequence numbers stay aligned without extra synchronization."""
+    it), so sequence numbers stay aligned without extra synchronization.
+
+    Hardening (round 8): each peer read polls in widening slices
+    (250 ms doubling to 5 s) against one shared deadline (``SR_KV_TIMEOUT_MS``)
+    instead of a single opaque blocking call, so a transient coordination
+    hiccup retries while a dead peer is named precisely. Peers that miss the
+    deadline raise :class:`PeerLossError` — or, under
+    ``on_peer_loss='continue'``, are recorded dead and excluded from every
+    later gather and barrier; the returned stacks then carry one row per
+    SURVIVING process (callers must iterate the leading dim, not
+    process_count). The barrier id is suffixed with the live set while
+    degraded so disjoint partitions can never collide on one barrier key."""
     global _KV_SEQ
     import io
 
     import jax
     from jax._src import distributed as _jdist
 
+    from ..utils import faults
+
     client = _jdist.global_state.client
     assert client is not None, "jax.distributed is not initialized"
     pid, n = jax.process_index(), jax.process_count()
     seq = _KV_SEQ
     _KV_SEQ += 1
+    live = [p for p in range(n) if p not in _DEAD_PEERS]
     leaves, treedef = jax.tree_util.tree_flatten(arrays)
     buf = io.BytesIO()
     np.savez(buf, *[np.asarray(a) for a in leaves])
     client.key_value_set_bytes(f"srag/{seq}/{pid}", buf.getvalue())
-    gathered = []
-    for p in range(n):
-        raw = client.blocking_key_value_get_bytes(
-            f"srag/{seq}/{p}", _KV_TIMEOUT_MS
-        )
+
+    timeout_ms = kv_timeout_ms()
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    injector = faults.active()
+    fault_peers: set[int] = set()
+    if injector.armed("exchange_timeout"):
+        hit = injector.fire("exchange_timeout")
+        if hit is not None:
+            tgt = hit.get("peer")
+            others = [p for p in live if p != pid]
+            fault_peers = {int(tgt)} if tgt is not None else set(others[-1:])
+
+    gathered: dict[int, list] = {}
+    missing: list[int] = []
+    for p in live:
+        if p in fault_peers:
+            missing.append(p)
+            continue
+        raw = None
+        slice_ms = 250.0
+        while raw is None:
+            remaining_ms = (deadline - time.monotonic()) * 1000.0
+            if remaining_ms <= 0:
+                break
+            try:
+                raw = client.blocking_key_value_get_bytes(
+                    f"srag/{seq}/{p}",
+                    int(max(1.0, min(slice_ms, remaining_ms))),
+                )
+            except Exception:  # noqa: BLE001 — a timed-out poll slice or a
+                # transient coordination-service error: back off, retry
+                # until the shared deadline
+                slice_ms = min(slice_ms * 2.0, 5000.0)
+        if raw is None:
+            missing.append(p)
+            continue
         with np.load(io.BytesIO(raw)) as z:
-            gathered.append([z[f"arr_{j}"] for j in range(len(z.files))])
-    client.wait_at_barrier(f"srag-done/{seq}", _KV_TIMEOUT_MS)
-    client.key_value_delete(f"srag/{seq}/{pid}")
+            gathered[p] = [z[f"arr_{j}"] for j in range(len(z.files))]
+
+    if missing:
+        if on_peer_loss != "continue":
+            raise PeerLossError(seq, missing, timeout_ms)
+        _DEAD_PEERS.update(missing)
+        live = [p for p in live if p not in missing]
+        warnings.warn(
+            f"allgather seq {seq}: lost process(es) {sorted(missing)}; "
+            f"continuing on survivors {live} (on_peer_loss='continue')",
+            stacklevel=2,
+        )
+
+    barrier_id = f"srag-done/{seq}"
+    try:
+        if len(live) < n:
+            # survivors-only barrier; the live set in the id keeps disjoint
+            # partitions off one another's barrier key
+            barrier_id += "/l" + "-".join(str(p) for p in live)
+            client.wait_at_barrier(barrier_id, timeout_ms, process_ids=live)
+        else:
+            client.wait_at_barrier(barrier_id, timeout_ms)
+    except Exception as e:  # noqa: BLE001
+        if on_peer_loss != "continue":
+            raise RuntimeError(
+                f"allgather seq {seq}: barrier failed across processes "
+                f"{live} ({e})"
+            ) from e
+        # a peer died between posting and the barrier: skip reclamation this
+        # round — the next gather's read loop will name it missing
+    else:
+        client.key_value_delete(f"srag/{seq}/{pid}")
     stacked = [
-        np.stack([g[j] for g in gathered]) for j in range(len(leaves))
+        np.stack([gathered[p][j] for p in live]) for j in range(len(leaves))
     ]
     return jax.tree_util.tree_unflatten(treedef, stacked)
 
@@ -138,7 +275,7 @@ def allgather_transport() -> str:
     return "xla-collective"
 
 
-def all_gather_migration_pool(local_pool_arrays):
+def all_gather_migration_pool(local_pool_arrays, on_peer_loss: str = "raise"):
     """Gather each host's compact migration pool (flattened best members:
     FlatTrees-style arrays + losses) into the global pool on every host.
 
@@ -147,12 +284,17 @@ def all_gather_migration_pool(local_pool_arrays):
     Populations over TCP for the same purpose, SURVEY.md §2.3). On TPU/GPU
     this is ``process_allgather`` (an XLA collective); on the multi-process
     CPU rig it falls back to the coordination-service KV store, since the
-    CPU backend refuses multi-process XLA computations."""
+    CPU backend refuses multi-process XLA computations.
+
+    ``on_peer_loss`` governs the KV transport's deadline behavior (see
+    ``_kv_allgather``); under 'continue' the returned stacks have one row
+    per SURVIVING process. The XLA collective path cannot degrade — a lost
+    peer aborts the runtime regardless of the policy."""
     import jax
     from jax.experimental import multihost_utils
 
     if jax.process_count() > 1 and jax.default_backend() == "cpu":
-        return _kv_allgather(local_pool_arrays)
+        return _kv_allgather(local_pool_arrays, on_peer_loss=on_peer_loss)
     return jax.tree_util.tree_map(
         lambda a: multihost_utils.process_allgather(np.asarray(a), tiled=False),
         local_pool_arrays,
@@ -177,8 +319,9 @@ class DoubleBufferedExchange:
     sequence deterministic across processes — no threads are involved.
     """
 
-    def __init__(self):
+    def __init__(self, on_peer_loss: str = "raise"):
         self._pending = None
+        self._on_peer_loss = on_peer_loss
 
     def roll(self, local_pool_arrays):
         """Submit this iteration's local payload; gather and return the
@@ -186,7 +329,7 @@ class DoubleBufferedExchange:
         prev, self._pending = self._pending, local_pool_arrays
         if prev is None:
             return None
-        return all_gather_migration_pool(prev)
+        return all_gather_migration_pool(prev, on_peer_loss=self._on_peer_loss)
 
     def flush(self):
         """Drain the slot after the loop: gather and return the last
@@ -194,4 +337,4 @@ class DoubleBufferedExchange:
         prev, self._pending = self._pending, None
         if prev is None:
             return None
-        return all_gather_migration_pool(prev)
+        return all_gather_migration_pool(prev, on_peer_loss=self._on_peer_loss)

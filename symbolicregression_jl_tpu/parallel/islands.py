@@ -56,17 +56,43 @@ def async_search_one_output(
     stdin_reader=None,
     recorder=None,
     out_j: int = 1,
+    checkpoint_base: str | None = None,
 ):
     """Async-island counterpart of search._search_one_output (same contract)."""
-    from ..search import SearchResult, _init_population, _rescore_population, get_cur_maxsize
+    from ..search import (
+        SearchResult,
+        _init_population,
+        _poison_populations,
+        _quarantine_nonfinite,
+        _rescore_population,
+        get_cur_maxsize,
+    )
+    from ..utils import faults
+    from ..utils.checkpoint import (
+        SearchCheckpoint,
+        SearchCheckpointer,
+        options_fingerprint,
+    )
     from ..utils.export_csv import save_hall_of_fame
 
     scorer = BatchScorer(dataset, options)
     nfeatures = dataset.n_features
     n_islands = options.populations
+    injector = (
+        faults.install(options.fault_spec)
+        if options.fault_spec
+        else faults.active()
+    )
+    ckptr = (
+        SearchCheckpointer.from_options(options, checkpoint_base)
+        if checkpoint_base
+        else None
+    )
 
     hof = HallOfFame(options.maxsize)
     if saved_state is not None:
+        # eval totals span the whole lineage (checkpoint .meta.json sidecar)
+        scorer.num_evals = float(getattr(saved_state, "num_evals", 0.0) or 0.0)
         pops = []
         for pop in saved_state.populations[:n_islands]:
             pop = pop.copy()
@@ -126,10 +152,13 @@ def async_search_one_output(
     start_time = time.time()
     stop_reason: list = [None]
     cycles_left = [niterations] * n_islands
+    completed = [0]  # finished work units (dispatch-loop thread only)
 
     def work_unit(i: int, iteration: int):
         """One island's iteration: the reference's _dispatch_s_r_cycle
         (/root/reference/src/SymbolicRegression.jl:1088-1129)."""
+        # simulated preemption; counts one call per work unit
+        injector.maybe_die("peer_death")
         with lock:
             pop = pops[i].copy()
             stats = shared_stats.copy()  # deep copy per work unit
@@ -169,6 +198,9 @@ def async_search_one_output(
         writes and progress rendering happen after release (hof is mutated
         nowhere else, so reading it lock-free here is safe)."""
         t_head = time.time()
+        hit = injector.fire("nan_flood")
+        if hit is not None:
+            _poison_populations([pop], float(hit.get("frac", 0.75)))
         with lock:
             pops[i] = pop
             hof.merge(best_seen, options)
@@ -177,6 +209,9 @@ def async_search_one_output(
                 shared_stats.update(m.get_complexity(options))
             shared_stats.move_window()
             shared_stats.normalize()
+            # non-finite quarantine: a majority-NaN/Inf island is re-seeded
+            # from the hall of fame before it can wedge the tournaments
+            _quarantine_nonfinite([pop], hof, options)
             # migration into THIS island from current snapshots
             if options.migration:
                 all_best = [
@@ -192,7 +227,32 @@ def async_search_one_output(
                         frontier, pops[i], options, options.fraction_replaced_hof, rng
                     )
         if output_file and options.save_to_file:
-            save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+            save_hall_of_fame(
+                output_file, hof, options, dataset.variable_names,
+                num_evals=scorer.num_evals,
+            )
+        completed[0] += 1
+        if ckptr is not None:
+            # iteration-equivalents: n_islands completed work units ~ one
+            # lockstep iteration (the wall-clock cadence fires regardless).
+            # Best-effort snapshot (exact=False): island states are copied
+            # under the lock, resume rescore-warm-starts from them.
+            it_eq, rem = divmod(completed[0], n_islands)
+            if (rem == 0 and ckptr.due(it_eq)) or (rem != 0 and ckptr.due(0)):
+                with lock:
+                    ck = SearchCheckpoint(
+                        iteration=it_eq,
+                        niterations=niterations,
+                        scheduler="async",
+                        exact=False,
+                        populations=[p.copy() for p in pops],
+                        hall_of_fame=hof.copy(),
+                        num_evals=float(scorer.num_evals),
+                        options_fingerprint=options_fingerprint(options),
+                        wall_time=time.time() - start_time,
+                        out_j=out_j,
+                    )
+                ckptr.save(ck)
         reporter.update(
             hof, scorer.num_evals, dataset.variable_names,
             y_variable_name=dataset.y_variable_name,

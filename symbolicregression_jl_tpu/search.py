@@ -105,6 +105,52 @@ def _rescore_population(
     return pop
 
 
+def _poison_populations(pops: list[Population], frac: float) -> None:
+    """nan_flood fault: overwrite the leading ``frac`` of every population's
+    losses/scores with NaN — the storm the quarantine must absorb."""
+    for pop in pops:
+        k = max(1, int(round(frac * pop.n)))
+        for m in pop.members[:k]:
+            m.loss = float("nan")
+            m.score = float("nan")
+
+
+def _quarantine_nonfinite(
+    pops: list[Population], hof: HallOfFame, options: Options
+) -> int:
+    """Non-finite quarantine: a population whose loss vector went
+    majority-NaN/Inf in one iteration (optimizer excursion, poisoned data
+    batch) would wedge the tournament — every comparison against inf/NaN
+    keeps the poisoned members alive forever. Reset the non-finite members
+    of such populations from the hall-of-fame Pareto frontier (fresh
+    PopMember copies: new ref/birth, finite losses) and return the number
+    reset. Populations with only a minority of non-finite members are left
+    alone — inf is the routine marker for invalid candidates and ordinary
+    selection handles it. The hall of fame itself never admits non-finite
+    losses (HallOfFame.update), so the frontier is always a safe donor."""
+    frontier = hof.pareto_frontier()
+    if not frontier:
+        return 0
+    n_reset = 0
+    for pop in pops:
+        bad = [
+            k for k, m in enumerate(pop.members) if not np.isfinite(m.loss)
+        ]
+        if 2 * len(bad) <= pop.n:
+            continue
+        for j, k in enumerate(bad):
+            src = frontier[j % len(frontier)]
+            pop.members[k] = PopMember(
+                src.tree.copy(),
+                src.score,
+                src.loss,
+                complexity=src.get_complexity(options),
+                parent=src.ref,
+            )
+            n_reset += 1
+    return n_reset
+
+
 def _search_one_output(
     dataset: Dataset,
     options: Options,
@@ -116,9 +162,29 @@ def _search_one_output(
     stdin_reader=None,
     recorder=None,
     out_j: int = 1,
+    resume=None,
+    checkpoint_base: str | None = None,
 ) -> SearchResult:
+    from .utils import faults
+    from .utils.checkpoint import (
+        SearchCheckpoint,
+        SearchCheckpointer,
+        options_fingerprint,
+    )
+    from .models.pop_member import counter_state, restore_counter_state
+
     scorer = BatchScorer(dataset, options)
     nfeatures = dataset.n_features
+    injector = (
+        faults.install(options.fault_spec)
+        if options.fault_spec
+        else faults.active()
+    )
+    ckptr = (
+        SearchCheckpointer.from_options(options, checkpoint_base)
+        if checkpoint_base
+        else None
+    )
     from .utils.recorder import Recorder
 
     # a multi-output equation_search owns ONE shared recorder (dumped once,
@@ -131,7 +197,23 @@ def _search_one_output(
     # -- initialize (warm start re-scores saved members: reference
     #    _initialize_search!, /root/reference/src/SymbolicRegression.jl:722-795)
     hof = HallOfFame(options.maxsize)
-    if saved_state is not None:
+    start_iter = 0
+    if resume is not None:
+        # bit-exact continuation (SearchCheckpoint, exact=True): populations,
+        # hall of fame, RNG stream, and the member id counters are restored
+        # VERBATIM — no rescoring, no refill — so iteration start_iter
+        # proceeds exactly as the uninterrupted run's would have
+        pops = list(resume.populations)
+        hof = resume.hall_of_fame
+        scorer.num_evals = float(resume.num_evals)
+        if resume.rng_state is not None:
+            rng.bit_generator.state = resume.rng_state
+        if resume.counters is not None:
+            restore_counter_state(resume.counters)
+        start_iter = int(resume.iteration)
+    elif saved_state is not None:
+        # best-effort continuation: the eval budget spans the whole lineage
+        scorer.num_evals = float(getattr(saved_state, "num_evals", 0.0) or 0.0)
         pops = []
         for pop in saved_state.populations:
             pop = pop.copy()
@@ -157,6 +239,9 @@ def _search_one_output(
         ]
 
     stats = RunningSearchStatistics(options.maxsize)
+    if resume is not None and resume.stats_frequencies is not None:
+        stats.frequencies[:] = np.asarray(resume.stats_frequencies)
+        stats.normalize()
     stats_list = [stats] * len(pops)  # shared: lockstep updates at barriers only
     early_stop = options.early_stop_fn()
     if options.jit_warmup:
@@ -178,7 +263,11 @@ def _search_one_output(
         niterations, options, use_bar=bool(options.progress), verbosity=verbosity
     )
 
-    for iteration in range(niterations):
+    for iteration in range(start_iter, niterations):
+        # simulated preemption (peer_death fault): fires BEFORE the
+        # iteration's work, so the last completed checkpoint is the resume
+        # point — exactly the window a real kill would leave
+        injector.maybe_die("peer_death")
         curmaxsize = get_cur_maxsize(iteration, niterations, options)
 
         best_seen = s_r_cycle_lockstep(
@@ -193,6 +282,9 @@ def _search_one_output(
             recorder=recorder,
         )
         optimize_and_simplify_populations(pops, scorer, options, rng, recorder)
+        hit = injector.fire("nan_flood")
+        if hit is not None:
+            _poison_populations(pops, float(hit.get("frac", 0.75)))
         if recorder.enabled:
             for i, pop in enumerate(pops):
                 recorder.record_population(out_j, i + 1, iteration, pop, options)
@@ -207,6 +299,13 @@ def _search_one_output(
                 stats.update(m.get_complexity(options))
         stats.move_window()
         stats.normalize()
+
+        n_quarantined = _quarantine_nonfinite(pops, hof, options)
+        if n_quarantined and verbosity > 0:
+            print(
+                f"[quarantine] iteration {iteration + 1}: reset "
+                f"{n_quarantined} non-finite members from the hall of fame"
+            )
 
         # migration (reference: /root/reference/src/SymbolicRegression.jl:933-943)
         if options.migration:
@@ -223,7 +322,30 @@ def _search_one_output(
                 migrate(frontier, pop, options, options.fraction_replaced_hof, rng)
 
         if output_file and options.save_to_file:
-            save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+            save_hall_of_fame(
+                output_file, hof, options, dataset.variable_names,
+                num_evals=scorer.num_evals,
+            )
+
+        if ckptr is not None and ckptr.due(iteration + 1):
+            # end-of-iteration boundary: everything iteration+1 will consume
+            # (RNG stream, counters, stats, populations, hof) is captured, so
+            # the resumed run replays the remaining iterations bit-exactly
+            ckptr.save(SearchCheckpoint(
+                iteration=iteration + 1,
+                niterations=niterations,
+                scheduler="lockstep",
+                exact=True,
+                populations=pops,
+                hall_of_fame=hof,
+                num_evals=float(scorer.num_evals),
+                rng_state=rng.bit_generator.state,
+                stats_frequencies=stats.frequencies.copy(),
+                counters=counter_state(),
+                options_fingerprint=options_fingerprint(options),
+                wall_time=time.time() - start_time,
+                out_j=out_j,
+            ))
 
         reporter.update(
             hof,
@@ -260,7 +382,10 @@ def _search_one_output(
         recorder.dump()
     if output_file and options.save_to_file:
         # final write: the saved file must match the returned frontier
-        save_hall_of_fame(output_file, hof, options, dataset.variable_names)
+        save_hall_of_fame(
+            output_file, hof, options, dataset.variable_names,
+            num_evals=scorer.num_evals,
+        )
     result = SearchResult(
         hall_of_fame=hof,
         populations=pops,
@@ -298,6 +423,7 @@ def equation_search(
     variable_names: list[str] | None = None,
     y_variable_names=None,
     saved_state=None,
+    resume_from: str | None = None,
     verbosity: int | None = None,
     parallelism: str | None = None,
     X_units=None,
@@ -317,6 +443,16 @@ def equation_search(
     overrides ``options.scheduler``; ``None`` keeps the options value.
     ``y_variable_names`` names the output variable(s) for rendering (str, or
     list with one entry per output row).
+
+    ``resume_from`` restores a full-state checkpoint written by a prior run
+    with ``Options.checkpoint_every`` (a snapshot path or the checkpoint
+    base, newest snapshot wins; multi-output runs append ``.out{j}`` like
+    ``output_file``). On the serial (lockstep) scheduler, resuming a
+    matching-options run continues BIT-EXACTLY — the final hall of fame is
+    identical to the uninterrupted run's. Device/async schedulers (and any
+    cross-scheduler resume) warm-start instead: populations and hall of fame
+    are rescored and the remaining ``niterations - iteration`` iterations
+    run. Mutually exclusive with ``saved_state``.
     """
     options = options or Options()
     if parallelism is not None:
@@ -364,6 +500,42 @@ def equation_search(
     if saved is not None and not isinstance(saved, (list, tuple)):
         saved = [saved]
 
+    resumes = None
+    if resume_from is not None:
+        if saved is not None:
+            raise ValueError(
+                "resume_from and saved_state are mutually exclusive: a "
+                "checkpoint already carries the populations and hall of fame"
+            )
+        import warnings
+
+        from .utils.checkpoint import load_checkpoint
+        from .utils.checkpoint import options_fingerprint as _ofp
+
+        resumes = []
+        for j in range(nout):
+            base_j = resume_from if nout == 1 else f"{resume_from}.out{j + 1}"
+            try:
+                ck = load_checkpoint(base_j)
+            except FileNotFoundError:
+                # multi-host device runs snapshot per process (.p{pid})
+                import jax
+
+                if jax.process_count() <= 1:
+                    raise
+                ck = load_checkpoint(f"{base_j}.p{jax.process_index()}")
+            if ck.options_fingerprint and tuple(ck.options_fingerprint) != _ofp(
+                options
+            ):
+                warnings.warn(
+                    "resume_from: checkpoint was written with different "
+                    "search options (operators/sizes/seed); continuing as a "
+                    "best-effort warm start — exact resume is not guaranteed",
+                    stacklevel=2,
+                )
+                ck.exact = False  # demote: verbatim state may not even fit
+            resumes.append(ck)
+
     if y_variable_names is None:
         y_names = [None] * nout
     elif isinstance(y_variable_names, str):
@@ -403,6 +575,12 @@ def equation_search(
         base = options.output_file or _default_base
         return base if nout == 1 else f"{base}.out{j + 1}"
 
+    def _ckpt_base(j):
+        # mirrors _output_file's .out{j} convention; the schedulers gate on
+        # Options.checkpoint_every / checkpoint_every_seconds being set
+        base = options.checkpoint_file or "sr_checkpoint.pkl"
+        return base if nout == 1 else f"{base}.out{j + 1}"
+
     # per-output RNG streams: multi-output fits spawn one child stream per
     # output for EVERY scheduler, so serial and concurrent execution of the
     # same fit are seed-for-seed identical (the concurrent path below cannot
@@ -418,29 +596,49 @@ def equation_search(
     shared_recorder = Recorder(options)
 
     def _run_one(j, dataset, reader=None, quiet=False):
+        saved_j = saved[j] if saved is not None else None
+        nit = niterations
+        resume_kw = {}
+        if resumes is not None:
+            ck = resumes[j]
+            if (
+                options.scheduler == "lockstep"
+                and ck.exact
+                and ck.scheduler == "lockstep"
+            ):
+                # bit-exact continuation: the serial scheduler restores the
+                # snapshot verbatim and runs iterations [ck.iteration,
+                # niterations) on the restored RNG stream
+                resume_kw["resume"] = ck
+            else:
+                # cross-scheduler / non-exact snapshot: rescored warm start
+                # over the REMAINING budget
+                saved_j = ck
+                nit = max(0, niterations - int(ck.iteration))
         kw = dict(
-            saved_state=saved[j] if saved is not None else None,
+            saved_state=saved_j,
             verbosity=0 if quiet else verbosity,
             output_file=_output_file(j),
             stdin_reader=reader,
+            checkpoint_base=_ckpt_base(j),
         )
         if options.scheduler == "async":
             from .parallel.islands import async_search_one_output
 
             return async_search_one_output(
-                dataset, options, niterations, child_rngs[j],
+                dataset, options, nit, child_rngs[j],
                 recorder=shared_recorder, out_j=j + 1, **kw
             )
         if options.scheduler == "device":
             from .models.device_search import device_search_one_output
 
             return device_search_one_output(
-                dataset, options, niterations, child_rngs[j],
+                dataset, options, nit, child_rngs[j],
                 recorder=shared_recorder, out_j=j + 1, **kw
             )
         return _search_one_output(
-            dataset, options, niterations, child_rngs[j],
-            recorder=shared_recorder, out_j=j + 1, **kw
+            dataset, options, nit, child_rngs[j],
+            recorder=shared_recorder, out_j=j + 1, **kw, **resume_kw
         )
 
     # --- concurrent multi-output (ALL schedulers): one search per host

@@ -1,13 +1,29 @@
-"""Resume a search from a saved hall-of-fame CSV.
+"""Checkpointing: full-state snapshots plus hall-of-fame CSV resume.
 
-The reference's CSV output is write-only — its only resume path is the
-in-memory ``saved_state`` object (/root/reference/src/SearchUtils.jl:410-450
-writes, nothing reads). This module closes that gap: ``load_saved_state``
-parses the ``Complexity,Loss,Equation`` rows back into trees through the
-sympy bridge (export_sympy.sympy_to_node) and returns a warm-startable
-state. Losses in the file are treated as stale: every scheduler RESCORES
-saved hall-of-fame members against the current dataset on warm start, so a
-checkpoint written against one dataset can seed a search on another.
+Two tiers of persistence live here:
+
+1. **Full-state checkpoints** (round 8): :class:`SearchCheckpointer` writes
+   rolling pickle snapshots — populations, hall of fame, RNG state,
+   adaptive-parsimony frequencies, ``num_evals``, and the member id counters
+   — atomically (tmp + fsync + ``os.replace``) on a configurable cadence
+   (``Options.checkpoint_every`` iterations and/or
+   ``checkpoint_every_seconds``). ``equation_search(resume_from=...)``
+   restores the newest snapshot: **bit-exact** continuation on the serial
+   (lockstep) scheduler — the resumed run's hall of fame is identical to the
+   uninterrupted run's — and a rescored warm start on the device/async
+   schedulers (their state lives on-device / across threads, so snapshots
+   are decoded observations, not the exact machine state).
+
+2. **CSV resume**: the reference's CSV output is write-only — its only
+   resume path is the in-memory ``saved_state`` object
+   (/root/reference/src/SearchUtils.jl:410-450 writes, nothing reads).
+   ``load_saved_state`` parses the ``Complexity,Loss,Equation`` rows back
+   into trees and returns a warm-startable state. Losses in the file are
+   treated as stale: every scheduler RESCORES saved hall-of-fame members
+   against the current dataset on warm start, so a checkpoint written
+   against one dataset can seed a search on another. A ``.meta.json``
+   sidecar written next to the CSV carries ``num_evals`` so warm-started
+   runs don't under-report total evaluations.
 
 Equations are parsed by a recursive-descent parser for string_tree's own
 grammar (tree.py:224-253) — exact structural round-trip, no algebraic
@@ -19,9 +35,25 @@ grammar does not cover fall back to the sympy bridge.
 from __future__ import annotations
 
 import csv
+import dataclasses
+import json
+import os
+import pickle
 import re
+import time
 
-__all__ = ["LoadedState", "load_saved_state", "parse_equation"]
+__all__ = [
+    "LoadedState",
+    "load_saved_state",
+    "parse_equation",
+    "SearchCheckpoint",
+    "SearchCheckpointer",
+    "latest_checkpoint",
+    "load_checkpoint",
+    "options_fingerprint",
+]
+
+CHECKPOINT_FORMAT = 1
 
 # string_tree's complex-constant rendering: "(Re±Imim)", e.g. "(2-0.5im)",
 # "(1e+03+2.5e-05im)". Unambiguous vs infix binaries, which always have
@@ -199,4 +231,197 @@ def load_saved_state(
             m = PopMember(tree, loss, loss, complexity=comp)
             hof.update(m, options)
 
-    return LoadedState(hof, options, variable_names)
+    state = LoadedState(hof, options, variable_names)
+    # .meta.json sidecar (save_hall_of_fame): restores the eval budget so a
+    # warm-started run's reported total spans the whole lineage
+    meta_path = path + ".meta.json"
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                state.num_evals = float(json.load(f).get("num_evals", 0.0))
+        except (OSError, ValueError):
+            pass  # corrupt/foreign sidecar: keep the 0.0 default
+    return state
+
+
+# -- full-state checkpoints (round 8) ----------------------------------------
+
+
+def options_fingerprint(options) -> tuple:
+    """A light, picklable summary of the options that shape search dynamics.
+    Stored in every snapshot so ``resume_from`` can WARN on a mismatch —
+    callables and device config make the full Options unpicklable, and a
+    hard error would block legitimate cross-config warm starts."""
+    ops = options.operators
+    return (
+        tuple(op.name for op in ops.binary),
+        tuple(op.name for op in ops.unary),
+        int(options.maxsize),
+        int(options.populations),
+        int(options.population_size),
+        int(options.ncycles_per_iteration),
+        options.seed,
+    )
+
+
+@dataclasses.dataclass
+class SearchCheckpoint:
+    """One full-state snapshot of a running search.
+
+    Quacks like ``saved_state`` (``populations`` / ``hall_of_fame`` /
+    ``num_evals`` / ``pareto_frontier``) so the device/async schedulers can
+    warm-start from it through their existing rescore path; the serial
+    scheduler additionally consumes ``rng_state`` / ``stats_frequencies`` /
+    ``counters`` for bit-exact continuation (``exact=True``)."""
+
+    iteration: int  # iterations COMPLETED when the snapshot was taken
+    niterations: int  # the run's total budget (resume runs the remainder)
+    scheduler: str
+    exact: bool  # bit-exact continuation supported (serial scheduler only)
+    populations: list
+    hall_of_fame: object
+    num_evals: float
+    rng_state: dict | None = None  # np.random.Generator.bit_generator.state
+    stats_frequencies: object = None  # RunningSearchStatistics.frequencies
+    counters: tuple | None = None  # pop_member.counter_state()
+    options_fingerprint: tuple = ()
+    wall_time: float = 0.0
+    out_j: int = 1
+    format_version: int = CHECKPOINT_FORMAT
+
+    @property
+    def pareto_frontier(self):
+        return self.hall_of_fame.pareto_frontier()
+
+
+def _list_snapshots(base: str) -> list[tuple[int, str]]:
+    """(seq, path) of every ``{base}.NNNNNN`` snapshot, ascending."""
+    d = os.path.dirname(base) or "."
+    name = os.path.basename(base)
+    out = []
+    try:
+        entries = os.listdir(d)
+    except OSError:
+        return []
+    for e in entries:
+        if e.startswith(name + "."):
+            tail = e[len(name) + 1 :]
+            if tail.isdigit():
+                out.append((int(tail), os.path.join(d, e)))
+    return sorted(out)
+
+
+def latest_checkpoint(base: str) -> str | None:
+    """Path of the newest ``{base}.NNNNNN`` snapshot, or None."""
+    snaps = _list_snapshots(base)
+    return snaps[-1][1] if snaps else None
+
+
+def load_checkpoint(path: str) -> SearchCheckpoint:
+    """Load a snapshot. ``path`` may be a snapshot file or a checkpoint base
+    (``Options.checkpoint_file``), in which case the newest snapshot wins."""
+    target = path
+    if not os.path.isfile(target):
+        latest = latest_checkpoint(path)
+        if latest is None:
+            raise FileNotFoundError(
+                f"no checkpoint at {path!r} (nor any {path}.NNNNNN snapshot)"
+            )
+        target = latest
+    with open(target, "rb") as f:
+        ckpt = pickle.load(f)
+    if not isinstance(ckpt, SearchCheckpoint):
+        raise ValueError(f"{target!r} is not a SearchCheckpoint snapshot")
+    return ckpt
+
+
+class SearchCheckpointer:
+    """Atomic rolling snapshot writer.
+
+    Snapshots are ``{base}.{seq:06d}``, written tmp-first with an fsync and
+    promoted by ``os.replace`` — a crash mid-write (exercised by the
+    ``ckpt_crash`` fault) can only ever leave a ``.tmp`` orphan behind, never
+    a torn snapshot; the previous snapshot stays loadable. At most ``keep``
+    snapshots are retained (oldest pruned after each successful write). The
+    sequence continues from existing snapshots, so a resumed run never
+    overwrites its ancestors' files."""
+
+    def __init__(
+        self,
+        base: str,
+        every_iterations: int | None = None,
+        every_seconds: float | None = None,
+        keep: int = 3,
+    ):
+        self.base = base
+        self.every_iterations = every_iterations
+        self.every_seconds = every_seconds
+        self.keep = max(1, int(keep))
+        self._last_time = time.time()
+        self._last_iter_saved = -1
+        existing = _list_snapshots(base)
+        self._seq = existing[-1][0] + 1 if existing else 0
+
+    @classmethod
+    def from_options(cls, options, base: str) -> "SearchCheckpointer | None":
+        """None when checkpointing is disabled (both cadences unset)."""
+        if (
+            options.checkpoint_every is None
+            and options.checkpoint_every_seconds is None
+        ):
+            return None
+        return cls(
+            base,
+            every_iterations=options.checkpoint_every,
+            every_seconds=options.checkpoint_every_seconds,
+            keep=options.checkpoint_keep,
+        )
+
+    def due(self, iterations_done: int) -> bool:
+        """Should a snapshot be written after ``iterations_done`` complete
+        iterations? Safe to call repeatedly at the same count (async
+        scheduler): a count already saved never re-triggers."""
+        if (
+            self.every_iterations
+            and iterations_done > 0
+            and iterations_done % self.every_iterations == 0
+            and iterations_done != self._last_iter_saved
+        ):
+            return True
+        return (
+            self.every_seconds is not None
+            and time.time() - self._last_time >= self.every_seconds
+        )
+
+    def save(self, ckpt: SearchCheckpoint) -> str:
+        from . import faults
+
+        path = f"{self.base}.{self._seq:06d}"
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(ckpt, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        hit = faults.active().fire("ckpt_crash")
+        if hit is not None:
+            # kill-after-tmp-write: the torn-write window the atomic rename
+            # exists to close — the tmp orphan stays, the promote never runs
+            if hit.get("mode") == "exit":
+                os._exit(int(hit.get("code", 44)))
+            raise faults.CheckpointWriteCrash(
+                f"injected ckpt_crash before os.replace -> {path!r}"
+            )
+        os.replace(tmp, path)
+        self._seq += 1
+        self._last_time = time.time()
+        self._last_iter_saved = int(ckpt.iteration)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        snaps = _list_snapshots(self.base)
+        for _, p in snaps[: -self.keep]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass

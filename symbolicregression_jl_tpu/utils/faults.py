@@ -1,0 +1,180 @@
+"""Deterministic fault injection for the fault-tolerant search runtime.
+
+Production-scale searches on preemptible pods die in specific, reproducible
+ways: a peer stops posting to the per-iteration exchange, a host is killed
+mid-checkpoint-write, a population's loss vector goes NaN after an optimizer
+excursion. This module lets tests and the CI smoke *schedule* those failures
+deterministically instead of waiting for them: a spec names a fault site and
+the 0-based call count at which it fires, so the same run always fails at the
+same place.
+
+Spec grammar (``Options.fault_spec`` or the ``SR_FAULT_SPEC`` env var)::
+
+    spec   := rule (';' rule)*
+    rule   := site '@' count [':' key '=' value (',' key '=' value)*]
+
+e.g. ``"nan_flood@2:frac=0.9;ckpt_crash@1"`` — flood the populations with
+NaNs on the third ``nan_flood`` site call, crash the second checkpoint write.
+
+Fault sites (each scheduler documents which it consults):
+
+- ``exchange_timeout`` — the KV-store allgather treats a peer (param
+  ``peer``; default: the highest-id other live process) as having never
+  posted, exercising the deadline/peer-loss path without waiting for a real
+  network failure.
+- ``peer_death`` — the process exits hard (``os._exit``, param ``code``,
+  default 43), simulating preemption; ``mode=raise`` raises
+  :class:`FaultInjected` instead, for in-process kill/resume tests.
+- ``ckpt_crash`` — :class:`~.checkpoint.SearchCheckpointer` dies AFTER the
+  tmp write but BEFORE ``os.replace`` (the classic torn-write window);
+  raises :class:`CheckpointWriteCrash` (``mode=exit`` hard-exits, param
+  ``code``, default 44).
+- ``nan_flood`` — a fraction (param ``frac``, default 0.75) of every
+  population's losses is overwritten with NaN, the storm the non-finite
+  quarantine must absorb.
+
+One injector is active per process at a time: ``install()`` (called by the
+schedulers when ``Options.fault_spec`` is set, resetting call counts) takes
+precedence over the lazily-built ``SR_FAULT_SPEC`` env injector used by
+subprocess rigs, where process-lifetime counting is the right semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+__all__ = [
+    "FAULT_SITES",
+    "FaultInjected",
+    "CheckpointWriteCrash",
+    "FaultRule",
+    "FaultInjector",
+    "parse_fault_spec",
+    "install",
+    "active",
+]
+
+FAULT_SITES = ("exchange_timeout", "peer_death", "ckpt_crash", "nan_flood")
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired (``mode=raise`` variants)."""
+
+
+class CheckpointWriteCrash(FaultInjected):
+    """Injected ``ckpt_crash``: the snapshot's tmp file was written and
+    fsynced, but the atomic promote never ran."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    site: str
+    at: int  # 0-based call count at the site when the rule fires
+    params: tuple  # ((key, value), ...) — hashable, dict'ed at fire time
+
+
+def _coerce(value: str):
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        return value
+
+
+def parse_fault_spec(spec: str) -> tuple[FaultRule, ...]:
+    """Parse the spec grammar above; raises ValueError on malformed input
+    (Options.__post_init__ calls this to validate ``fault_spec`` eagerly)."""
+    rules = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        head, _, tail = chunk.partition(":")
+        site, sep, count = head.partition("@")
+        site = site.strip()
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} in {chunk!r}; "
+                f"expected one of {FAULT_SITES}"
+            )
+        if not sep or not count.strip().isdigit():
+            raise ValueError(
+                f"fault rule {chunk!r} needs 'site@N' with integer N"
+            )
+        params = []
+        if tail:
+            for kv in tail.split(","):
+                key, sep2, value = kv.partition("=")
+                if not sep2 or not key.strip():
+                    raise ValueError(f"malformed fault param {kv!r} in {chunk!r}")
+                params.append((key.strip(), _coerce(value.strip())))
+        rules.append(FaultRule(site, int(count.strip()), tuple(params)))
+    return tuple(rules)
+
+
+class FaultInjector:
+    """Per-site call counter + rule matcher. Thread-safe: the async island
+    scheduler fires sites from worker threads."""
+
+    def __init__(self, rules: tuple[FaultRule, ...] = ()):
+        self._rules = tuple(rules)
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def armed(self, site: str) -> bool:
+        """Any rule targets this site? (Cheap pre-check so un-faulted runs
+        skip the counting lock entirely.)"""
+        return any(r.site == site for r in self._rules)
+
+    def fire(self, site: str) -> dict | None:
+        """Count one call at ``site``; return the matching rule's params
+        (a fresh dict) when a rule's count is reached, else None."""
+        if not self._rules:
+            return None
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+        for r in self._rules:
+            if r.site == site and r.at == n:
+                return dict(r.params)
+        return None
+
+    def maybe_die(self, site: str = "peer_death") -> None:
+        """Fire ``site``; on a hit, exit hard (simulated preemption) or, for
+        ``mode=raise`` rules, raise FaultInjected."""
+        hit = self.fire(site)
+        if hit is None:
+            return
+        if hit.get("mode") == "raise":
+            raise FaultInjected(f"injected {site}")
+        os._exit(int(hit.get("code", 43)))
+
+
+_NULL = FaultInjector()
+_installed: FaultInjector | None = None
+_env_injector: FaultInjector | None = None
+
+
+def install(spec: str | None) -> FaultInjector:
+    """Install a process-wide injector from a spec (``Options.fault_spec``),
+    resetting call counts; ``None`` clears back to the env/null injector."""
+    global _installed
+    _installed = FaultInjector(parse_fault_spec(spec)) if spec else None
+    return _installed if _installed is not None else active()
+
+
+def active() -> FaultInjector:
+    """The process's active injector: the installed one, else one built
+    (once) from SR_FAULT_SPEC, else a null injector that never fires."""
+    global _env_injector
+    if _installed is not None:
+        return _installed
+    if _env_injector is None:
+        spec = os.environ.get("SR_FAULT_SPEC", "")
+        _env_injector = FaultInjector(parse_fault_spec(spec)) if spec else _NULL
+    return _env_injector

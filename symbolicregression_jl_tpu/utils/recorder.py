@@ -121,5 +121,11 @@ class Recorder:
     def dump(self):
         if not self.enabled:
             return
-        with open(self.path, "w") as fh:
+        # tmp + atomic promote (the export_csv pattern): a crash mid-dump
+        # must not corrupt an existing record file
+        import os
+
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(_sanitize(self.data), fh)
+        os.replace(tmp, self.path)

@@ -83,6 +83,85 @@ def test_load_works_across_schedulers(tmp_path):
     assert np.isfinite(min(m.loss for m in res.pareto_frontier))
 
 
+def test_device_checkpoint_resume_preserves_frontier(tmp_path):
+    """Full-state snapshots from the device engine (exact=False) resume as a
+    rescored warm start over the remaining budget — the Pareto frontier must
+    not lose ground."""
+    from symbolicregression_jl_tpu import load_checkpoint
+
+    X, y = _problem()
+    opts = _opts(
+        tmp_path, checkpoint_every=2,
+        checkpoint_file=str(tmp_path / "dev.pkl"),
+    )
+    r1 = equation_search(X, y, options=opts, niterations=4, verbosity=0)
+    ck = load_checkpoint(str(tmp_path / "dev.pkl"))
+    assert ck.scheduler == "device" and not ck.exact
+    assert ck.iteration in (2, 4) and ck.num_evals > 0
+    assert ck.populations and ck.pareto_frontier
+
+    r2 = equation_search(
+        X, y, options=_opts(tmp_path, checkpoint_file=str(tmp_path / "d2.pkl")),
+        niterations=ck.iteration + 1, verbosity=0,
+        resume_from=str(tmp_path / "dev.pkl"),
+    )
+    best1 = min(m.loss for m in r1.pareto_frontier)
+    best2 = min(m.loss for m in r2.pareto_frontier)
+    # warm start from iteration >=2 state, small remaining budget: the
+    # rescored frontier seeds the hall of fame, so no ground is lost vs the
+    # snapshot itself (and usually vs the full run)
+    ck_best = min(m.loss for m in ck.pareto_frontier)
+    assert best2 <= ck_best + 1e-5
+    assert np.isfinite(best1) and np.isfinite(best2)
+    # lineage accounting: the resumed run's totals include the snapshot's
+    assert r2.num_evals > ck.num_evals
+
+
+def test_async_checkpoint_resume(tmp_path):
+    from symbolicregression_jl_tpu import load_checkpoint
+
+    X, y = _problem()
+    opts = _opts(
+        tmp_path, scheduler="async", checkpoint_every=1,
+        checkpoint_file=str(tmp_path / "as.pkl"),
+    )
+    equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    ck = load_checkpoint(str(tmp_path / "as.pkl"))
+    assert ck.scheduler == "async" and not ck.exact
+    res = equation_search(
+        X, y,
+        options=_opts(
+            tmp_path, scheduler="async",
+            checkpoint_file=str(tmp_path / "as2.pkl"),
+        ),
+        niterations=ck.iteration + 1, verbosity=0,
+        resume_from=str(tmp_path / "as.pkl"),
+    )
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+
+
+def test_csv_meta_sidecar_restores_num_evals(tmp_path):
+    """save_hall_of_fame writes a .meta.json sidecar; load_saved_state reads
+    it so warm-started runs report eval totals spanning the whole lineage."""
+    import json
+
+    X, y = _problem()
+    opts = _opts(tmp_path)
+    r1 = equation_search(X, y, options=opts, niterations=2, verbosity=0)
+    meta = tmp_path / "hof.csv.meta.json"
+    assert meta.exists()
+    assert json.loads(meta.read_text())["num_evals"] == pytest.approx(
+        r1.num_evals
+    )
+    state = load_saved_state(str(tmp_path / "hof.csv"), opts)
+    assert state.num_evals == pytest.approx(r1.num_evals)
+    r2 = equation_search(
+        X, y, options=_opts(tmp_path, ncycles_per_iteration=1),
+        niterations=1, verbosity=0, saved_state=state,
+    )
+    assert r2.num_evals > r1.num_evals
+
+
 def test_regressor_from_file_round_trip(tmp_path):
     """SRRegressor.from_file: predict works immediately on the restored
     frontier, and a refit warm-starts from it (PySR-parity API; the

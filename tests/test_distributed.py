@@ -1,12 +1,17 @@
 """Multi-host helpers (single-process degenerate behavior + slicing math)."""
 
 import numpy as np
+import pytest
 
 from symbolicregression_jl_tpu.parallel.distributed import (
+    PeerLossError,
     all_gather_migration_pool,
+    dead_peers,
     initialize,
     is_distributed,
+    kv_timeout_ms,
     process_island_slice,
+    reset_peer_state,
 )
 
 
@@ -24,3 +29,42 @@ def test_allgather_identity_single_process():
     pool = {"loss": np.arange(4.0), "kind": np.ones((4, 8), np.int32)}
     out = all_gather_migration_pool(pool)
     np.testing.assert_array_equal(np.asarray(out["loss"]).reshape(-1, 4)[0], pool["loss"])
+
+
+def test_island_slice_re_derives_over_survivors():
+    """Graceful degradation: with a ``live`` subset the islands re-stripe
+    across the survivors only (this process is rank sorted(live).index(pid))."""
+    # single-process rigs run as process 0
+    assert process_island_slice(16, live=[0]) == (0, 16)
+    assert process_island_slice(16, live=[0, 3]) == (0, 8)
+    with pytest.raises(ValueError, match="not in the live set"):
+        process_island_slice(16, live=[1, 2])
+
+
+def test_kv_timeout_env_override(monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "1234")
+    assert kv_timeout_ms() == 1234
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "not-a-number")
+    assert kv_timeout_ms() == 600_000
+    monkeypatch.delenv("SR_KV_TIMEOUT_MS")
+    assert kv_timeout_ms() == 600_000
+
+
+def test_peer_loss_error_names_seq_and_peers():
+    err = PeerLossError(seq=7, missing=[1, 3], timeout_ms=250)
+    assert err.seq == 7 and err.missing == (1, 3)
+    msg = str(err)
+    assert "seq 7" in msg and "1, 3" in msg and "250 ms" in msg
+    assert "SR_KV_TIMEOUT_MS" in msg and "on_peer_loss" in msg
+
+
+def test_dead_peer_bookkeeping_resets():
+    assert dead_peers() == frozenset()
+    try:
+        from symbolicregression_jl_tpu.parallel import distributed as dist
+
+        dist._DEAD_PEERS.add(2)
+        assert dead_peers() == frozenset({2})
+    finally:
+        reset_peer_state()
+    assert dead_peers() == frozenset()

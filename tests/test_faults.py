@@ -1,0 +1,224 @@
+"""Fault-injection harness + fault-tolerant runtime behavior.
+
+The deterministic injector (utils/faults.py) schedules failures at exact call
+counts, so every test here reproduces a production failure mode — preemption
+mid-search, a crash inside the checkpoint write window, a NaN storm — at the
+same place every run:
+
+- spec grammar round-trip and eager Options validation,
+- serial kill-at-iteration-k -> ``resume_from`` continuation that is
+  bit-exact against the uninterrupted run (the headline checkpoint/resume
+  guarantee),
+- ``ckpt_crash`` (kill-after-tmp-write) leaves the previous snapshot
+  loadable — the torn-write window the atomic rename exists to close,
+- ``nan_flood`` -> non-finite quarantine recovery on serial and async
+  schedulers.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import (
+    Options,
+    equation_search,
+    load_checkpoint,
+)
+from symbolicregression_jl_tpu.utils import faults
+from symbolicregression_jl_tpu.utils.checkpoint import latest_checkpoint
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    faults.install(None)  # never leak an armed injector into other tests
+
+
+def _problem(n=80, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+    return X, y
+
+
+def _opts(tmp_path, **kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=2,
+        population_size=12,
+        ncycles_per_iteration=8,
+        maxsize=12,
+        seed=0,
+        scheduler="lockstep",
+        save_to_file=False,
+        checkpoint_file=str(tmp_path / "ck.pkl"),
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+# -- spec grammar -------------------------------------------------------------
+
+
+def test_parse_fault_spec_round_trip():
+    rules = faults.parse_fault_spec(
+        "nan_flood@2:frac=0.9;ckpt_crash@1;peer_death@3:mode=raise,code=7"
+    )
+    assert [r.site for r in rules] == ["nan_flood", "ckpt_crash", "peer_death"]
+    assert rules[0].at == 2 and dict(rules[0].params) == {"frac": 0.9}
+    assert rules[1].params == ()
+    assert dict(rules[2].params) == {"mode": "raise", "code": 7}
+
+
+@pytest.mark.parametrize(
+    "bad", ["gremlin@1", "nan_flood", "nan_flood@x", "nan_flood@1:frac"]
+)
+def test_parse_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec(bad)
+
+
+def test_options_validate_fault_spec_and_on_peer_loss(tmp_path):
+    with pytest.raises(ValueError):
+        _opts(tmp_path, fault_spec="gremlin@1")
+    with pytest.raises(ValueError):
+        _opts(tmp_path, on_peer_loss="shrug")
+    with pytest.raises(ValueError):
+        _opts(tmp_path, checkpoint_every=0)
+
+
+def test_injector_fires_at_exact_count():
+    inj = faults.FaultInjector(faults.parse_fault_spec("nan_flood@2:frac=0.5"))
+    assert inj.armed("nan_flood") and not inj.armed("ckpt_crash")
+    assert inj.fire("nan_flood") is None  # count 0
+    assert inj.fire("nan_flood") is None  # count 1
+    assert inj.fire("nan_flood") == {"frac": 0.5}  # count 2: fires
+    assert inj.fire("nan_flood") is None  # once only
+
+
+# -- checkpoint / resume ------------------------------------------------------
+
+
+def _frontier_str(res, options):
+    return ";".join(
+        f"{m.get_complexity(options)}:{m.loss:.17g}:"
+        f"{m.tree.string_tree(options.operators)}"
+        for m in sorted(
+            res.hall_of_fame.pareto_frontier(),
+            key=lambda m: m.get_complexity(options),
+        )
+    )
+
+
+def test_serial_kill_and_resume_is_bit_exact(tmp_path):
+    """The headline guarantee: a serial search killed at iteration k and
+    resumed from its checkpoint produces a hall of fame IDENTICAL to the
+    uninterrupted run's (same options, same seed)."""
+    X, y = _problem()
+    full = equation_search(
+        X, y, options=_opts(tmp_path), niterations=4, verbosity=0
+    )
+
+    # same run, preempted at the start of iteration 2 (0-based count: the
+    # third maybe_die call) with a snapshot after every iteration
+    killed_opts = _opts(
+        tmp_path, checkpoint_every=1, fault_spec="peer_death@2:mode=raise"
+    )
+    with pytest.raises(faults.FaultInjected):
+        equation_search(X, y, options=killed_opts, niterations=4, verbosity=0)
+    ck_base = str(tmp_path / "ck.pkl")
+    newest = latest_checkpoint(ck_base)
+    assert newest is not None
+    ck = load_checkpoint(ck_base)
+    assert ck.iteration == 2 and ck.exact and ck.scheduler == "lockstep"
+
+    resumed = equation_search(
+        X, y, options=_opts(tmp_path, checkpoint_every=1),
+        niterations=4, verbosity=0, resume_from=ck_base,
+    )
+    opts = _opts(tmp_path)
+    assert _frontier_str(resumed, opts) == _frontier_str(full, opts)
+    # the eval total spans the whole lineage, not just the resumed half
+    assert resumed.num_evals == pytest.approx(full.num_evals)
+
+
+def test_resume_from_and_saved_state_are_exclusive(tmp_path):
+    X, y = _problem()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        equation_search(
+            X, y, options=_opts(tmp_path), niterations=1, verbosity=0,
+            resume_from=str(tmp_path / "ck.pkl"), saved_state=object(),
+        )
+
+
+def test_resume_from_missing_checkpoint_raises(tmp_path):
+    X, y = _problem()
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        equation_search(
+            X, y, options=_opts(tmp_path), niterations=1, verbosity=0,
+            resume_from=str(tmp_path / "nothing.pkl"),
+        )
+
+
+def test_ckpt_crash_leaves_previous_snapshot_loadable(tmp_path):
+    """Kill-after-tmp-write: the second snapshot's write crashes BETWEEN the
+    tmp write and the atomic promote. The first snapshot must stay loadable
+    and the crashed write must only ever leave a .tmp orphan behind."""
+    X, y = _problem()
+    opts = _opts(
+        tmp_path, checkpoint_every=1, fault_spec="ckpt_crash@1"
+    )
+    with pytest.raises(faults.CheckpointWriteCrash):
+        equation_search(X, y, options=opts, niterations=4, verbosity=0)
+
+    ck_base = str(tmp_path / "ck.pkl")
+    ck = load_checkpoint(ck_base)  # snapshot 0 survived the crash
+    assert ck.iteration == 1
+    orphans = [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    assert orphans, "crashed write should leave its tmp file behind"
+    # and the run is resumable from the surviving snapshot
+    resumed = equation_search(
+        X, y, options=_opts(tmp_path), niterations=4, verbosity=0,
+        resume_from=ck_base,
+    )
+    assert np.isfinite(min(m.loss for m in resumed.pareto_frontier))
+
+
+def test_checkpoint_retention_prunes_old_snapshots(tmp_path):
+    X, y = _problem()
+    opts = _opts(tmp_path, checkpoint_every=1, checkpoint_keep=2)
+    equation_search(X, y, options=opts, niterations=5, verbosity=0)
+    snaps = sorted(
+        f for f in os.listdir(tmp_path)
+        if f.startswith("ck.pkl.") and f.split(".")[-1].isdigit()
+    )
+    assert len(snaps) == 2, snaps
+    assert load_checkpoint(str(tmp_path / "ck.pkl")).iteration == 5
+
+
+# -- nan_flood -> quarantine --------------------------------------------------
+
+
+def test_nan_flood_quarantine_recovers_serial(tmp_path):
+    X, y = _problem()
+    opts = _opts(tmp_path, fault_spec="nan_flood@1:frac=0.9")
+    res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    frontier = res.hall_of_fame.pareto_frontier()
+    assert frontier and all(np.isfinite(m.loss) for m in frontier)
+    # populations were re-seeded from the hall of fame, not left wedged on NaN
+    finite = [
+        np.isfinite(m.loss) for pop in res.populations for m in pop.members
+    ]
+    assert np.mean(finite) > 0.5
+
+
+def test_nan_flood_quarantine_recovers_async(tmp_path):
+    X, y = _problem()
+    opts = _opts(
+        tmp_path, scheduler="async", fault_spec="nan_flood@1:frac=0.9"
+    )
+    res = equation_search(X, y, options=opts, niterations=3, verbosity=0)
+    frontier = res.hall_of_fame.pareto_frontier()
+    assert frontier and all(np.isfinite(m.loss) for m in frontier)
