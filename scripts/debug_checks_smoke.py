@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""CI smoke: run a tiny end-to-end search with SR_DEBUG_CHECKS=1 so the
+flat-IR verifier is live at every host<->device decode boundary, then
+checkpoint and resume to cover the always-on checkpoint verification path.
+
+Exits non-zero if any invariant check fires on real search traffic (which
+would mean either a genuine IR corruption bug or an over-strict invariant).
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SR_DEBUG_CHECKS"] = "1"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from symbolicregression_jl_tpu import Options, equation_search  # noqa: E402
+from symbolicregression_jl_tpu.utils.checkpoint import latest_checkpoint  # noqa: E402
+
+
+def main() -> int:
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 80)).astype(np.float32)
+    y = (2.0 * np.cos(X[1]) + X[0] ** 2).astype(np.float32)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        for scheduler in ("lockstep", "device"):
+            opts = Options(
+                binary_operators=["+", "-", "*"],
+                unary_operators=["cos"],
+                populations=2,
+                population_size=12,
+                ncycles_per_iteration=8,
+                maxsize=12,
+                seed=0,
+                scheduler=scheduler,
+                save_to_file=False,
+                checkpoint_file=os.path.join(tmp, f"ck_{scheduler}.pkl"),
+                checkpoint_every=1,
+            )
+            res = equation_search(X, y, niterations=2, options=opts, verbosity=0)
+            n = len(res.hall_of_fame.pareto_frontier())
+            print(f"[debug-checks-smoke] scheduler={scheduler}: "
+                  f"{n} pareto-frontier members")
+            assert n >= 1, f"empty hall of fame under scheduler={scheduler}"
+
+            path = latest_checkpoint(opts.checkpoint_file)
+            assert path, f"no checkpoint written under scheduler={scheduler}"
+            res = equation_search(
+                X, y, niterations=3, options=opts, verbosity=0, resume_from=path
+            )
+            assert len(res.hall_of_fame.pareto_frontier()) >= 1
+            print(f"[debug-checks-smoke] scheduler={scheduler}: resume ok")
+
+    print("[debug-checks-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,8 +52,10 @@ from .ops.losses import (
     SmoothedL1HingeLoss,
     ZeroOneLoss,
 )
+from .analysis.ir_verify import FlatIRError, verify_flat_trees
 from .parallel.distributed import PeerLossError
 from .utils.checkpoint import (
+    CheckpointError,
     SearchCheckpoint,
     SearchCheckpointer,
     latest_checkpoint,
@@ -85,10 +87,13 @@ __all__ = [
     "flatten_trees",
     "resolve_operators",
     "load_saved_state",
+    "CheckpointError",
+    "FlatIRError",
     "SearchCheckpoint",
     "SearchCheckpointer",
     "latest_checkpoint",
     "load_checkpoint",
+    "verify_flat_trees",
     "PeerLossError",
     "DWDMarginLoss",
     "ExpLoss",

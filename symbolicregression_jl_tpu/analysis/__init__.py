@@ -1,0 +1,11 @@
+"""Static analysis & runtime debug checks.
+
+Two legs: :mod:`.lint` (sr-lint, the AST-based JAX-footgun linter — pure
+stdlib, also loadable standalone by ``scripts/sr_lint.py`` without JAX) and
+:mod:`.ir_verify` (the FlatTrees invariant verifier behind the
+``Options.debug_checks`` / ``SR_DEBUG_CHECKS=1`` gate).
+"""
+
+from .ir_verify import FlatIRError, debug_checks_enabled, verify_flat_trees
+
+__all__ = ["FlatIRError", "debug_checks_enabled", "verify_flat_trees"]

@@ -1,0 +1,832 @@
+"""sr-lint: project-specific static analysis for the SR-JAX codebase.
+
+An AST linter for the whole *classes* of bug this engine has already paid
+for once: tracer-unsafe Python control flow, host math baked into compiled
+programs, trace-time env reads, blocking host syncs inside the engine loop,
+PRNG key reuse, donated-buffer reuse — and, above all, **incomplete
+compiled-function cache keys** (the r06 regression: the ``k_copt`` AOT key
+omitted ``loss_function_jit`` and silently served a stale const-opt
+objective across searches).
+
+Pure stdlib (ast + tokenize): ``scripts/sr_lint.py`` loads this module by
+file path so the CI lint job runs without JAX installed.
+
+Rules
+-----
+==========  ==================================================================
+SRL001      Python ``if``/``while`` on a traced value inside jit/scan code
+SRL002      ``np.`` / ``math.`` call on a traced value inside jit/scan code
+SRL003      blocking host sync (``.item()``, ``np.asarray``,
+            ``block_until_ready``) inside an engine-loop hot path
+SRL004      ``os.environ`` / ``os.getenv`` read inside jit/scan code
+            (trace-time constant baked into the compiled program)
+SRL005      PRNG key reused after ``jax.random.split`` (without rebinding)
+SRL006      donated buffer read after the donating call
+SRL007      compile-cache key misses an ``Options`` field its cached body
+            reads (the r06 ``k_copt`` class)
+==========  ==================================================================
+
+Suppressions: a trailing ``# srl: disable=SRL001[,SRL002] [-- reason]``
+comment silences those rule ids on its line; a comment-only line applies to
+the next line. ``sr-lint`` reports suppressed findings only with
+``--show-suppressed``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import tokenize
+
+__all__ = ["RULES", "Finding", "lint_source", "lint_file", "lint_paths"]
+
+RULES = {
+    "SRL001": (
+        "tracer-branch",
+        "Python if/while on a traced value inside jitted/scanned code — "
+        "tracers have no concrete truth value; use lax.cond/lax.select or "
+        "hoist the branch to a static argument",
+    ),
+    "SRL002": (
+        "host-math-in-jit",
+        "np./math. call on a traced value inside jitted/scanned code — "
+        "numpy forces a trace-time concretization (ConcretizationTypeError "
+        "at best, a silently baked constant at worst); use jnp/lax",
+    ),
+    "SRL003": (
+        "host-sync-in-hot-loop",
+        "blocking host sync (.item(), np.asarray, block_until_ready) inside "
+        "an engine-loop hot path — serializes the dispatch pipeline; move "
+        "the readback off the critical path or batch it",
+    ),
+    "SRL004": (
+        "env-read-in-jit",
+        "os.environ/os.getenv read inside jitted/scanned code — the value "
+        "is frozen at trace time and silently ignored afterwards; read it "
+        "at build time and bake it into the compile-cache key",
+    ),
+    "SRL005": (
+        "key-reuse-after-split",
+        "PRNG key used again after jax.random.split — correlated randomness; "
+        "rebind (`key, sub = jax.random.split(key)`) or use the split halves",
+    ),
+    "SRL006": (
+        "donated-buffer-reuse",
+        "buffer read after being donated to a jitted call — donated inputs "
+        "are deleted by XLA; reading one returns garbage or raises",
+    ),
+    "SRL007": (
+        "incomplete-cache-key",
+        "compiled-function cache key omits an Options field the cached "
+        "body reads — a second search with a different value for that field "
+        "silently reuses the stale executable (the r06 k_copt incident)",
+    ),
+}
+
+# -- project configuration ----------------------------------------------------
+
+#: engine-driver functions whose loops are latency-critical (SRL003 scope).
+#: Extend when a new scheduler loop lands.
+HOT_PATH_FUNCTIONS = {
+    "_search_one_output",
+    "device_search_one_output",
+    "async_search_one_output",
+    "s_r_cycle_lockstep",
+}
+
+#: parameter names treated as the Options object for SRL007.
+OPTIONS_PARAM_NAMES = {"options"}
+
+#: attribute reads on a traced value that are static (shape metadata) and
+#: therefore fine to branch on / feed to numpy.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "device"}
+
+#: jit-like wrappers: a function decorated with (a partial of) one of these,
+#: or passed to one, traces its Python body.
+JIT_WRAPPERS = {"jit", "pmap"}
+#: tracing combinators whose function-valued arguments trace.
+TRACING_CALLS = {
+    "scan", "while_loop", "cond", "switch", "fori_loop", "map",
+    "vmap", "grad", "value_and_grad", "jacfwd", "jacrev", "checkpoint",
+    "remat", "custom_jvp", "custom_vjp", "shard_map", "shard_map_compat",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str | None = None
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} {self.message}"
+
+
+# -- suppression comments -----------------------------------------------------
+
+def _parse_suppressions(source: str) -> dict[int, tuple[set[str], str | None]]:
+    """line -> (rule ids disabled on that line, reason). A comment-only line
+    also applies to the next line (long flagged lines put the pragma above)."""
+    out: dict[int, tuple[set[str], str | None]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):
+        return out
+    # lines that hold only a comment (and whitespace/NL)
+    code_lines = {
+        t.start[0]
+        for t in tokens
+        if t.type
+        not in (
+            tokenize.COMMENT,
+            tokenize.NL,
+            tokenize.NEWLINE,
+            tokenize.INDENT,
+            tokenize.DEDENT,
+            tokenize.ENDMARKER,
+        )
+    }
+    for t in tokens:
+        if t.type != tokenize.COMMENT:
+            continue
+        text = t.string.lstrip("#").strip()
+        if not text.startswith("srl:"):
+            continue
+        body = text[len("srl:"):].strip()
+        if not body.startswith("disable="):
+            continue
+        body = body[len("disable="):]
+        reason = None
+        if "--" in body:
+            body, reason = body.split("--", 1)
+            reason = reason.strip()
+        ids = {x.strip().upper() for x in body.split(",") if x.strip()}
+        line = t.start[0]
+        prev = out.get(line, (set(), None))
+        out[line] = (prev[0] | ids, reason or prev[1])
+        if line not in code_lines:  # standalone pragma: applies to next line
+            nxt = out.get(line + 1, (set(), None))
+            out[line + 1] = (nxt[0] | ids, reason or nxt[1])
+    return out
+
+
+# -- AST utilities ------------------------------------------------------------
+
+def _attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._srl_parent = node  # noqa: SLF001 — private annotation
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tail(dotted: str | None) -> str | None:
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this decorator/callee expression denote a jit-like wrapper?
+    Matches ``jit``, ``jax.jit``, ``functools.partial(jax.jit, ...)``."""
+    d = _dotted(node)
+    if d is not None and _tail(d) in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        callee = _dotted(node.func)
+        if callee is not None and _tail(callee) == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        # jax.jit(f, ...) used directly as a decorator factory
+        return _is_jit_expr(node.func)
+    return False
+
+
+def _jit_static_names(node: ast.AST) -> set[str]:
+    """static_argnames declared on a jit decorator expression."""
+    names: set[str] = set()
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                for elt in ast.walk(kw.value):
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        names.add(elt.value)
+        if node.args and isinstance(node.func, ast.Attribute | ast.Name):
+            callee = _dotted(node.func)
+            if callee is not None and _tail(callee) == "partial":
+                names |= _jit_static_names(node.args[0])
+    return names
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _collect_traced_functions(tree: ast.Module):
+    """Map FunctionDef -> set of static param names, for every function whose
+    body runs under tracing: jit-decorated, passed to a tracing combinator,
+    wrapped via ``jit(f)`` assignment, or *defined inside* a traced function
+    (nested defs execute at trace time)."""
+    by_name: dict[int, dict[str, ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_DEFS):
+            scope = id(getattr(node, "_srl_parent", tree))
+            by_name.setdefault(scope, {})[node.name] = node
+
+    traced: dict[ast.FunctionDef, set[str]] = {}
+
+    def _mark(fn, statics=frozenset()):
+        if fn in traced:
+            traced[fn] |= set(statics)
+        else:
+            traced[fn] = set(statics)
+
+    def _resolve(name_node: ast.AST, scope_node: ast.AST):
+        """A Name argument -> the FunctionDef it denotes, searched up the
+        lexical scope chain."""
+        if isinstance(name_node, ast.Lambda):
+            return None  # lambdas handled via containment
+        if not isinstance(name_node, ast.Name):
+            return None
+        cur = scope_node
+        while cur is not None:
+            fns = by_name.get(id(cur), {})
+            if name_node.id in fns:
+                return fns[name_node.id]
+            cur = getattr(cur, "_srl_parent", None)
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_DEFS):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    _mark(node, _jit_static_names(dec))
+        elif isinstance(node, ast.Call):
+            callee = _tail(_dotted(node.func))
+            if callee in JIT_WRAPPERS or callee in TRACING_CALLS:
+                scope = node
+                while scope is not None and not isinstance(scope, _FUNC_DEFS):
+                    scope = getattr(scope, "_srl_parent", None)
+                statics = _jit_static_names(node) if callee in JIT_WRAPPERS else ()
+                for arg in node.args:
+                    fn = _resolve(arg, scope or tree)
+                    if fn is not None:
+                        _mark(fn, statics)
+
+    # nested defs inside traced functions trace too (params are tracers)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(tree):
+            if not isinstance(node, _FUNC_DEFS) or node in traced:
+                continue
+            cur = getattr(node, "_srl_parent", None)
+            while cur is not None:
+                if isinstance(cur, _FUNC_DEFS) and cur in traced:
+                    _mark(node)
+                    changed = True
+                    break
+                cur = getattr(cur, "_srl_parent", None)
+    return traced
+
+
+def _traced_param_refs(expr: ast.AST, traced_params: set[str]) -> list[ast.Name]:
+    """Name loads of traced params inside ``expr`` that are NOT shielded by a
+    static construct (``.shape``-style attrs, ``len()``, ``isinstance()``,
+    ``is None`` comparisons)."""
+    hits: list[ast.Name] = []
+    for node in ast.walk(expr):
+        if not (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)):
+            continue
+        if node.id not in traced_params:
+            continue
+        shielded = False
+        cur, child = getattr(node, "_srl_parent", None), node
+        while cur is not None and cur is not getattr(expr, "_srl_parent", None):
+            if isinstance(cur, ast.Attribute) and cur.value is child and cur.attr in STATIC_ATTRS:
+                shielded = True
+                break
+            if isinstance(cur, ast.Call):
+                callee = _tail(_dotted(cur.func))
+                if callee in {"len", "isinstance", "type", "id"} and child in cur.args:
+                    shielded = True
+                    break
+            if isinstance(cur, ast.Compare):
+                ops_are_identity = all(
+                    isinstance(o, ast.Is | ast.IsNot) for o in cur.ops
+                )
+                if ops_are_identity:
+                    shielded = True
+                    break
+            child, cur = cur, getattr(cur, "_srl_parent", None)
+        if not shielded:
+            hits.append(node)
+    return hits
+
+
+def _enclosing_function(node: ast.AST):
+    cur = getattr(node, "_srl_parent", None)
+    while cur is not None and not isinstance(cur, _FUNC_DEFS):
+        cur = getattr(cur, "_srl_parent", None)
+    return cur
+
+
+def _inside(node: ast.AST, ancestor: ast.AST) -> bool:
+    cur = node
+    while cur is not None:
+        if cur is ancestor:
+            return True
+        cur = getattr(cur, "_srl_parent", None)
+    return False
+
+
+def _is_literal(node: ast.AST) -> bool:
+    try:
+        ast.literal_eval(node)
+        return True
+    except (ValueError, TypeError, SyntaxError, MemoryError, RecursionError):
+        return False
+
+
+# -- rule implementations -----------------------------------------------------
+
+def _check_traced_rules(tree, path, findings):
+    """SRL001 (tracer branch), SRL002 (np/math on tracer), SRL004 (env read)
+    — all scoped to traced-function bodies."""
+    traced = _collect_traced_functions(tree)
+    for fn, statics in traced.items():
+        traced_params = set(_param_names(fn)) - statics
+        # only walk THIS function's body, not nested defs twice (nested defs
+        # are separate entries in `traced`)
+        own_nodes = [
+            n
+            for n in ast.walk(fn)
+            if _enclosing_function(n) is fn and n is not fn
+        ]
+        for node in own_nodes:
+            if isinstance(node, ast.If | ast.While):
+                refs = _traced_param_refs(node.test, traced_params)
+                if refs:
+                    findings.append(Finding(
+                        "SRL001", path, node.lineno, node.col_offset,
+                        f"`{'while' if isinstance(node, ast.While) else 'if'}` "
+                        f"on traced value `{refs[0].id}` in traced function "
+                        f"`{fn.name}` — use lax.cond/lax.select or make it "
+                        "a static argument",
+                    ))
+            elif isinstance(node, ast.Call):
+                root = _dotted(node.func)
+                if root is not None and root.split(".", 1)[0] in {"np", "numpy", "math"}:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        refs = _traced_param_refs(arg, traced_params)
+                        if refs:
+                            findings.append(Finding(
+                                "SRL002", path, node.lineno, node.col_offset,
+                                f"`{root}(...)` applied to traced value "
+                                f"`{refs[0].id}` in traced function "
+                                f"`{fn.name}` — use jnp",
+                            ))
+                            break
+                callee = _tail(root)
+                if callee in {"getenv"} and root.startswith("os"):
+                    findings.append(Finding(
+                        "SRL004", path, node.lineno, node.col_offset,
+                        f"os.getenv read inside traced function `{fn.name}` — "
+                        "frozen at trace time; read at build time and key the "
+                        "cache on it",
+                    ))
+            elif isinstance(node, ast.Attribute):
+                if _dotted(node) == "os.environ":
+                    findings.append(Finding(
+                        "SRL004", path, node.lineno, node.col_offset,
+                        f"os.environ read inside traced function `{fn.name}` — "
+                        "frozen at trace time; read at build time and key the "
+                        "cache on it",
+                    ))
+
+
+def _check_hot_sync(tree, path, findings):
+    """SRL003: blocking host syncs inside loops of engine-driver functions."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, _FUNC_DEFS) or fn.name not in HOT_PATH_FUNCTIONS:
+            continue
+        loops = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.For | ast.While) and _enclosing_function(n) is fn
+        ]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not any(_inside(node, lp) for lp in loops):
+                continue
+            root = _dotted(node.func)
+            name = _tail(root)
+            sync = None
+            if name in {"asarray", "array"} and root and root.split(".", 1)[0] in {"np", "numpy"}:
+                # literal args are host data already — no device sync
+                if node.args and not _is_literal(node.args[0]):
+                    sync = f"{root}(...)"
+            elif isinstance(node.func, ast.Attribute) and not node.args:
+                if node.func.attr == "block_until_ready":
+                    sync = ".block_until_ready()"
+                elif node.func.attr == "item":
+                    sync = ".item()"
+            if sync:
+                findings.append(Finding(
+                    "SRL003", path, node.lineno, node.col_offset,
+                    f"blocking host sync {sync} inside the `{fn.name}` "
+                    "engine loop — stalls the dispatch pipeline",
+                ))
+
+
+def _split_key_arg(node: ast.Call) -> str | None:
+    """`jax.random.split(key[, n])` -> 'key' when arg0 is a plain Name."""
+    if _tail(_dotted(node.func)) != "split":
+        return None
+    d = _dotted(node.func)
+    if d is None or "random" not in d.split("."):
+        return None
+    if node.args and isinstance(node.args[0], ast.Name):
+        return node.args[0].id
+    return None
+
+
+def _check_key_reuse(tree, path, findings):
+    """SRL005: linear per-function scan — after `ks = jax.random.split(key)`
+    that does NOT rebind `key`, a later load of `key` is correlated reuse."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, _FUNC_DEFS):
+            continue
+        events: list[tuple[int, int, str, str, ast.AST]] = []  # (line, col, kind, name, node)
+        split_args: set[int] = set()  # Name nodes that ARE a split's argument
+        for node in ast.walk(fn):
+            if _enclosing_function(node) is not fn and node is not fn:
+                continue
+            if isinstance(node, ast.Assign):
+                key = None
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call) and _split_key_arg(sub):
+                        key = _split_key_arg(sub)
+                        for a in sub.args:
+                            for n in ast.walk(a):
+                                if isinstance(n, ast.Name):
+                                    split_args.add(id(n))
+                        break
+                targets: set[str] = set()
+                for t in node.targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            targets.add(sub.id)
+                if key and key not in targets:
+                    events.append((node.lineno, node.col_offset, "split", key, node))
+                # stores take effect AFTER the value expression evaluates:
+                # anchor them at the statement's end position
+                for name in targets:
+                    events.append(
+                        (node.end_lineno or node.lineno,
+                         node.end_col_offset or node.col_offset,
+                         "store", name, node)
+                    )
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if id(node) not in split_args:
+                    events.append((node.lineno, node.col_offset, "load", node.id, node))
+        events.sort(key=lambda e: (e[0], e[1]))
+        consumed: dict[str, int] = {}
+        for line, col, kind, name, _node in events:
+            if kind == "split":
+                if name in consumed and line > consumed[name]:
+                    findings.append(Finding(
+                        "SRL005", path, line, col,
+                        f"PRNG key `{name}` split again after jax.random.split "
+                        f"on line {consumed[name]} — identical halves; rebind "
+                        "the key between splits",
+                    ))
+                consumed[name] = line
+            elif kind == "store":
+                consumed.pop(name, None)
+            elif kind == "load" and name in consumed and line > consumed[name]:
+                findings.append(Finding(
+                    "SRL005", path, line, col,
+                    f"PRNG key `{name}` used after jax.random.split on line "
+                    f"{consumed[name]} — rebind or use the split halves",
+                ))
+                consumed.pop(name)  # one finding per split
+
+
+def _donating_assignments(fn: ast.FunctionDef):
+    """name -> donated positional indices, from
+    `f = jax.jit(g, donate_argnums=(0,))`-style assignments."""
+    out: dict[str, set[int]] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            continue
+        call = node.value
+        if not _is_jit_expr(call.func) and not (
+            _tail(_dotted(call.func)) in JIT_WRAPPERS
+        ):
+            continue
+        donated: set[int] = set()
+        for kw in call.keywords:
+            if kw.arg in {"donate_argnums", "donate_argnames"}:
+                for elt in ast.walk(kw.value):
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                        donated.add(elt.value)
+        if not donated:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = donated
+    return out
+
+
+def _check_donated_reuse(tree, path, findings):
+    """SRL006: a Name passed at a donated position of a donating call must
+    not be read afterwards (unless rebound)."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, _FUNC_DEFS):
+            continue
+        donors = _donating_assignments(fn)
+        if not donors:
+            continue
+        events: list[tuple[int, int, str, str]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                positions = donors.get(node.func.id)
+                if positions:
+                    for i, arg in enumerate(node.args):
+                        if i in positions and isinstance(arg, ast.Name):
+                            events.append(
+                                (node.lineno, node.col_offset, "donate", arg.id)
+                            )
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    events.append((node.lineno, node.col_offset, "load", node.id))
+                else:
+                    # a Store name binds AFTER its statement's value expression
+                    # evaluates: anchor at the enclosing statement's end
+                    stmt = node
+                    while stmt is not None and not isinstance(stmt, ast.stmt):
+                        stmt = getattr(stmt, "_srl_parent", None)
+                    anchor = stmt if stmt is not None else node
+                    events.append(
+                        (anchor.end_lineno or anchor.lineno,
+                         anchor.end_col_offset or anchor.col_offset,
+                         "store", node.id)
+                    )
+        events.sort(key=lambda e: (e[0], e[1]))
+        dead: dict[str, int] = {}
+        for line, col, kind, name in events:
+            if kind == "donate":
+                dead[name] = line
+            elif kind == "store":
+                dead.pop(name, None)
+            elif kind == "load" and name in dead and line > dead[name]:
+                findings.append(Finding(
+                    "SRL006", path, line, col,
+                    f"buffer `{name}` read after being donated on line "
+                    f"{dead[name]} — donated inputs are deleted by XLA",
+                ))
+                dead.pop(name)
+
+
+def _options_reads(fn: ast.FunctionDef) -> set[str]:
+    """Attribute reads on parameters that carry the Options object."""
+    params = set(_param_names(fn))
+    opt_names = {
+        p
+        for p in params
+        if p in OPTIONS_PARAM_NAMES
+    }
+    for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+        ann = a.annotation
+        if ann is not None and _tail(_dotted(ann)) == "Options":
+            opt_names.add(a.arg)
+    reads: set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in opt_names
+            and isinstance(node.ctx, ast.Load)
+        ):
+            reads.add(node.attr)
+    return reads
+
+
+def _module_call_graph(tree: ast.Module):
+    """module-level function name -> (direct option-field reads,
+    module-local callee names)."""
+    info: dict[str, tuple[set[str], set[str]]] = {}
+    for node in tree.body:
+        if isinstance(node, _FUNC_DEFS):
+            callees = {
+                _tail(_dotted(c.func))
+                for c in ast.walk(node)
+                if isinstance(c, ast.Call) and isinstance(c.func, ast.Name)
+            }
+            info[node.name] = (_options_reads(node), {c for c in callees if c})
+    return info
+
+
+def _transitive_options_reads(names, graph, _seen=None) -> set[str]:
+    seen = _seen if _seen is not None else set()
+    out: set[str] = set()
+    for name in names:
+        if name in seen or name not in graph:
+            continue
+        seen.add(name)
+        reads, callees = graph[name]
+        out |= reads
+        out |= _transitive_options_reads(callees, graph, seen)
+    return out
+
+
+def _check_cache_keys(tree, path, findings):
+    """SRL007: for each `key = (...)` tuple later used as `CACHE.get(key)`
+    (or `*_cache_put(key, ...)` / `CACHE.setdefault(key, ...)`), every
+    Options field read by the cache-miss branch — directly or through
+    module-local calls — must appear in the key tuple."""
+    graph = _module_call_graph(tree)
+    for fn in ast.walk(tree):
+        if not isinstance(fn, _FUNC_DEFS):
+            continue
+        # key-tuple assignments in this function
+        key_tuples: dict[str, ast.Assign] = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Tuple)
+            ):
+                key_tuples[node.targets[0].id] = node
+        if not key_tuples:
+            continue
+
+        body = list(ast.walk(fn))
+        for key_name, assign in key_tuples.items():
+            # cache use: CACHE.get(key) assigned to a result name, or a
+            # direct put/setdefault
+            result_name = None
+            used_as_key = False
+            for node in body:
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _tail(_dotted(node.func))
+                takes_key = any(
+                    isinstance(a, ast.Name) and a.id == key_name for a in node.args
+                )
+                if not takes_key:
+                    continue
+                if callee in {"get", "setdefault"} or (
+                    callee is not None and callee.endswith("cache_put")
+                ):
+                    used_as_key = True
+                    if callee == "get":
+                        parent = getattr(node, "_srl_parent", None)
+                        if (
+                            isinstance(parent, ast.Assign)
+                            and len(parent.targets) == 1
+                            and isinstance(parent.targets[0], ast.Name)
+                        ):
+                            result_name = parent.targets[0].id
+            if not used_as_key:
+                continue
+
+            # miss branch: `if <result> is None:` body (falls back to the
+            # whole remainder of small builder functions when absent)
+            miss_stmts: list[ast.stmt] = []
+            if result_name is not None:
+                for node in body:
+                    if not isinstance(node, ast.If):
+                        continue
+                    t = node.test
+                    if (
+                        isinstance(t, ast.Compare)
+                        and isinstance(t.left, ast.Name)
+                        and t.left.id == result_name
+                        and len(t.ops) == 1
+                        and isinstance(t.ops[0], ast.Is)
+                        and isinstance(t.comparators[0], ast.Constant)
+                        and t.comparators[0].value is None
+                    ):
+                        miss_stmts.extend(node.body)
+            if not miss_stmts:
+                continue  # no statically-visible miss branch: nothing to diff
+
+            in_key: set[str] = set()
+            for sub in ast.walk(assign.value):
+                if (
+                    isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id in OPTIONS_PARAM_NAMES
+                ):
+                    in_key.add(sub.attr)
+
+            direct: set[str] = set()
+            callees: set[str] = set()
+            for stmt in miss_stmts:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id in OPTIONS_PARAM_NAMES
+                        and isinstance(sub.ctx, ast.Load)
+                    ):
+                        direct.add(sub.attr)
+                    elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                        callees.add(sub.func.id)
+            body_reads = direct | _transitive_options_reads(callees, graph)
+            missing = sorted(body_reads - in_key)
+            if missing:
+                findings.append(Finding(
+                    "SRL007", path, assign.lineno, assign.col_offset,
+                    f"cache key `{key_name}` omits Options field(s) its "
+                    f"cached body reads: {', '.join(missing)} — a search "
+                    "with a different value silently reuses the stale "
+                    "compiled result",
+                ))
+
+
+# -- driver -------------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """Lint one source string. Returns ALL findings; suppressed ones carry
+    ``suppressed=True`` (callers filter)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("SRL000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    _attach_parents(tree)
+    findings: list[Finding] = []
+    _check_traced_rules(tree, path, findings)
+    _check_hot_sync(tree, path, findings)
+    _check_key_reuse(tree, path, findings)
+    _check_donated_reuse(tree, path, findings)
+    _check_cache_keys(tree, path, findings)
+
+    suppressions = _parse_suppressions(source)
+    for f in findings:
+        sup = suppressions.get(f.line)
+        if sup and (f.rule in sup[0] or "ALL" in sup[0]):
+            f.suppressed = True
+            f.reason = sup[1]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def lint_paths(paths) -> list[Finding]:
+    """Lint files and/or directory trees (``*.py``, skipping ``__pycache__``
+    and the lint fixture corpus, which is violations on purpose)."""
+    findings: list[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d != "__pycache__" and d != "lint_fixtures"
+                ]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        findings.extend(lint_file(os.path.join(dirpath, fname)))
+        else:
+            findings.extend(lint_file(p))
+    return findings
+
+
+def render_json(findings) -> str:
+    return json.dumps([f.as_dict() for f in findings], indent=2)

@@ -20,7 +20,7 @@ import dataclasses
 import math
 
 from .tree import Node
-from .units import DIMENSIONLESS, Dimensions, Quantity, parse_unit, parse_units_vector
+from .units import DIMENSIONLESS, Dimensions, Quantity
 
 __all__ = ["violates_dimensional_constraints", "WildcardQuantity"]
 

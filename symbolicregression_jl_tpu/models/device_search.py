@@ -32,6 +32,7 @@ import warnings
 
 import numpy as np
 
+from ..analysis.ir_verify import debug_checks_enabled
 from ..dataset import Dataset
 from ..options import Options
 from ..ops.evolve import EvoConfig, EvoState, _score_of, init_state, run_iteration
@@ -1387,6 +1388,18 @@ def _bs_to_members(bs_loss, bs_exists, bs_len, fields, cfg: EvoConfig, options):
         val,  # engine dtype (f32 or f64) — no rounding on decode
         bs_len,
     )
+    if debug_checks_enabled(options):
+        # late import: the flag-off path makes zero verifier calls
+        from ..analysis import ir_verify
+
+        live = np.asarray(bs_exists) & (np.asarray(bs_len) >= 1)
+        ir_verify.verify_flat_trees(
+            # verify exactly the rows decoded below (others are never read)
+            FlatTrees(*(np.asarray(a)[live] for a in flat)),
+            options.operators,
+            allow_empty=False,
+            where="device_search._bs_to_members: ",
+        )
     for s in range(len(bs_loss)):
         if not bs_exists[s] or bs_len[s] < 1:
             continue
@@ -1490,6 +1503,20 @@ def _decode_state_populations(state, I, P, cfg, options):
     length = np.asarray(state.length)
     loss = np.asarray(state.loss).astype(np.float64)
     score = np.asarray(state.score).astype(np.float64)
+    if debug_checks_enabled(options):
+        # late import: the flag-off path makes zero verifier calls
+        from ..analysis import ir_verify
+
+        ir_verify.verify_flat_trees(
+            FlatTrees(
+                kind.reshape(I * P, -1), opa.reshape(I * P, -1),
+                lhs.reshape(I * P, -1), rhs.reshape(I * P, -1),
+                feat.reshape(I * P, -1), val.reshape(I * P, -1),
+                length.reshape(I * P),
+            ),
+            options.operators,
+            where="device_search._decode_state_populations: ",
+        )
     pops = []
     slots = []
     for i in range(I):
@@ -2255,11 +2282,13 @@ def device_search_one_output(
             else:
                 prev_rb, pending_rb = pending_rb, rb
                 if prev_rb is not None:
+                    # srl: disable=SRL003 -- pipelined design point: consumes the PREVIOUS iteration's buffer after copy_to_host_async
                     _consume_readback(None, np.asarray(prev_rb), it)
         elif multi_host:
             # --- the iteration's single cross-host exchange (DCN): this
             # process's readback buffer + topn migration pool, allgathered ---
             with prof.stage("readback_d2h"):
+                # srl: disable=SRL003 -- the iteration's single deliberate sync point, profiled as readback_d2h
                 payload = tuple(np.asarray(a) for a in (rb, *pool_dev))
             with prof.stage("exchange"):
                 gathered = dist.all_gather_migration_pool(
@@ -2269,7 +2298,7 @@ def device_search_one_output(
             _consume_readback(gathered, None, it + 1)
         else:
             with prof.stage("readback_d2h"):
-                buf = np.asarray(rb)
+                buf = np.asarray(rb)  # srl: disable=SRL003 -- sync-readback mode (async_readback off): deliberate, profiled
             _consume_readback(None, buf, it + 1)
 
         # count AFTER the iteration's host-triggered rescore/simplify evals so
@@ -2344,6 +2373,7 @@ def device_search_one_output(
                 stop_code = int(
                     np.max(
                         dist.all_gather_migration_pool(
+                            # srl: disable=SRL003 -- wraps a host int, no device transfer
                             np.asarray([stop_code], np.int32),
                             on_peer_loss=options.on_peer_loss,
                         )
@@ -2410,10 +2440,11 @@ def device_search_one_output(
             (fl, fn_, *ffields), on_peer_loss=options.on_peer_loss
         )
         _note_lost_peers()
+        # srl: disable=SRL003 -- final hof exchange decode: runs once per search, after the engine loop
         for pi in range(np.asarray(g[0]).shape[0]):
-            bl = np.asarray(g[0][pi])
-            bn = np.asarray(g[1][pi]).astype(np.int32)
-            flds = [np.asarray(g[2 + j][pi]) for j in range(6)]
+            bl = np.asarray(g[0][pi])  # srl: disable=SRL003 -- final hof decode, cold path
+            bn = np.asarray(g[1][pi]).astype(np.int32)  # srl: disable=SRL003 -- final hof decode, cold path
+            flds = [np.asarray(g[2 + j][pi]) for j in range(6)]  # srl: disable=SRL003 -- final hof decode, cold path
             for m in _bs_to_members(
                 bl, np.isfinite(bl), bn, flds, cfg, options
             ):

@@ -9,8 +9,6 @@ from __future__ import annotations
 
 import threading
 
-import numpy as np
-
 from ..complexity import compute_complexity
 from ..tree import Node
 

@@ -23,7 +23,6 @@ from ..ops.flat import batch_bucket as _bucket
 from ..ops.flat import flatten_trees
 from ..ops.scoring import (
     batched_loss_bucketed,
-    batched_loss_jit,
     baseline_loss,
     loss_to_score,
     objective_loss_jit,
@@ -72,6 +71,11 @@ class BatchScorer:
         self.num_evals = 0.0
         # the async island scheduler scores from worker threads
         self._evals_lock = threading.Lock()
+        # debug-checks gate resolved ONCE here: the hot path below branches on
+        # a plain bool and makes zero verifier calls when off
+        from ..analysis.ir_verify import debug_checks_enabled
+
+        self._debug_checks = debug_checks_enabled(options)
         self._units_penalty = None
         if dataset.has_units:
             self._units_penalty = (
@@ -129,6 +133,19 @@ class BatchScorer:
         bucket = _bucket(P)
         padded = trees + [trees[0]] * (bucket - P)
         flat = flatten_trees(padded, self.max_nodes, dtype=self.dtype)
+        if self._debug_checks:
+            # late import so tests can monkeypatch ir_verify.verify_flat_trees
+            # and count calls (and so the flag-off path never touches it)
+            from ..analysis import ir_verify
+
+            ir_verify.verify_flat_trees(
+                flat,
+                self.opset,
+                n_features=self.dataset.n_features,
+                max_nodes=self.max_nodes,
+                allow_empty=False,
+                where="scorer.loss_many_async: ",
+            )
         if idx is None:
             X, y, w = self.X, self.y, self.w
             with self._evals_lock:

@@ -13,6 +13,7 @@ search, and non-constant slots are masked out of the update.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable
 
 import jax
@@ -30,7 +31,6 @@ from .flat import (
     slice_nodes,
 )
 from .interp import _Structure, _eval_one
-from .losses import weighted_mean_loss
 from .operators import OperatorSet
 
 __all__ = ["optimize_constants_batched"]
@@ -313,16 +313,40 @@ def _clamped_chunk(
     return max(1, min(chunk, int(budget // per_instance)))
 
 
+def _optimize_batch(
+    flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS",
+    complex_vals=False, objective=None, g_tol=0.0,
+):
+    """Host wrapper: resolve the SR_CONSTOPT_CHUNK chunk size *outside* the
+    jitted body so the env var is re-read on every call and participates in
+    the jit cache key as a static argument (reading it at trace time froze
+    the first value into every later executable — the r06 class)."""
+    chunk = int(os.environ.get("SR_CONSTOPT_CHUNK", 8))
+    # row-aware clamp: keep a chunk under ~2GB so big-n unbatched runs
+    # degrade to smaller chunks instead of crashing the device (observed:
+    # worker crash at n=1M with chunk=8); see _clamped_chunk
+    chunk = _clamped_chunk(
+        chunk, starts.shape[1], flat.kind.shape[1], X.shape[-1], X.dtype,
+        complex_vals,
+    )
+    chunk = max(1, min(chunk, starts.shape[0]))
+    return _optimize_batch_impl(
+        flat, X, y, w, starts, opset, loss_elem, iters, has_w,
+        algorithm=algorithm, complex_vals=complex_vals, objective=objective,
+        g_tol=g_tol, chunk=chunk,
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "opset", "loss_elem", "iters", "has_w", "algorithm", "complex_vals",
-        "objective", "g_tol",
+        "objective", "g_tol", "chunk",
     ),
 )
-def _optimize_batch(
+def _optimize_batch_impl(
     flat, X, y, w, starts, opset, loss_elem, iters, has_w, algorithm="BFGS",
-    complex_vals=False, objective=None, g_tol=0.0,
+    complex_vals=False, objective=None, g_tol=0.0, chunk=8,
 ):
     """starts: [P, S, N] initial constant vectors (S = 1 + nrestarts).
     Returns best (val [P,N], loss [P]) over restarts per tree.
@@ -337,8 +361,6 @@ def _optimize_batch(
     residuals, which at the 10k-row x 100x100-population config is tens of
     GB (observed: 46G requested on a 16G chip). Same tuning as the device
     engine's fallback (models/device_search.py)."""
-    import os
-
     N_slots = flat.kind.shape[1]
     loss_fn = remat_tree_loss(
         opset, loss_elem, X, y, w, has_w,
@@ -375,14 +397,8 @@ def _optimize_batch(
 
     structure = _Structure(*(jnp.asarray(a) for a in structure))
     P = starts.shape[0]
-    chunk = int(os.environ.get("SR_CONSTOPT_CHUNK", 8))
-    # row-aware clamp: keep a chunk under ~2GB so big-n unbatched runs
-    # degrade to smaller chunks instead of crashing the device (observed:
-    # worker crash at n=1M with chunk=8); see _clamped_chunk
-    chunk = _clamped_chunk(
-        chunk, starts.shape[1], N_slots, X.shape[-1], X.dtype, complex_vals
-    )
-    chunk = max(1, min(chunk, P))
+    # chunk is resolved (env read + row-aware clamp) by the _optimize_batch
+    # wrapper so it participates in the jit cache key
     # Pad the batch up to a chunk multiple (duplicating tree 0) rather than
     # shrinking the chunk to a divisor of P: shrink-to-divisor degrades to
     # chunk=1 (fully serialized lax.map) whenever P and chunk are coprime.

@@ -309,7 +309,8 @@ def unflatten_tree(flat: FlatTrees, p: int) -> Node:
     for i in range(n):
         k = int(kind[i])
         if k == KIND_CONST:
-            nodes.append(Node(0, is_const=True, val=float(val[i])))
+            # .item() keeps complex constants complex (float() would raise)
+            nodes.append(Node(0, is_const=True, val=val[i].item()))
         elif k == KIND_VAR:
             nodes.append(Node(0, is_const=False, feat=int(feat[i])))
         elif k == KIND_UNARY:

@@ -119,6 +119,17 @@ def batched_loss_bucketed(
     """
     lengths = np.asarray(flat.length)
     P, N = flat.kind.shape
+    from ..analysis.ir_verify import debug_checks_enabled
+
+    if debug_checks_enabled():
+        # the bucketed truncation below (slice_nodes) is only bit-identical
+        # when pad slots are exact zeros — verify before slicing. Late import
+        # so the flag-off path makes zero verifier calls (pinned by test).
+        from ..analysis import ir_verify
+
+        ir_verify.verify_flat_trees(
+            flat, opset, full_width=N, where="scoring.batched_loss_bucketed: "
+        )
     parts = length_buckets(lengths, N)
     if not length_buckets_enabled() or (
         len(parts) == 1 and parts[0][0] == N and P == batch_bucket(P)

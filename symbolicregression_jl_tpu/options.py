@@ -259,6 +259,13 @@ class Options:
     # deterministic fault injection (utils/faults.py) — same grammar as the
     # SR_FAULT_SPEC env var, e.g. "nan_flood@2:frac=0.9;ckpt_crash@1".
     fault_spec: str | None = None
+    # flat-IR invariant verification (analysis/ir_verify.py) at host<->device
+    # decode boundaries: True/False overrides, None defers to the
+    # SR_DEBUG_CHECKS env var. Off by default — resolved ONCE per search so
+    # the hot path carries zero verifier calls when disabled. Checkpoint
+    # *load* always verifies regardless (cold path, torn snapshots must not
+    # warm-start a search).
+    debug_checks: bool | None = None
 
     # -- derived (filled in __post_init__) -----------------------------------
     operators: OperatorSet = dataclasses.field(init=False)

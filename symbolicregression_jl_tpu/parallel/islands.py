@@ -31,7 +31,6 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
 import numpy as np
 
-from ..complexity import compute_complexity
 from ..models.adaptive_parsimony import RunningSearchStatistics
 from ..models.hall_of_fame import HallOfFame
 from ..models.migration import migrate

@@ -41,11 +41,16 @@ import os
 import pickle
 import re
 import time
+from typing import NamedTuple
+
+import numpy as np
 
 __all__ = [
+    "CheckpointError",
     "LoadedState",
     "load_saved_state",
     "parse_equation",
+    "FlatPopulations",
     "SearchCheckpoint",
     "SearchCheckpointer",
     "latest_checkpoint",
@@ -53,7 +58,19 @@ __all__ = [
     "options_fingerprint",
 ]
 
-CHECKPOINT_FORMAT = 1
+# format 2 (round 9): populations are stored as ONE flat postorder batch
+# (FlatPopulations) instead of pickled Node graphs — smaller, and every
+# documented FlatTrees invariant is verified on load so a corrupted or
+# truncated snapshot is rejected with a named invariant instead of
+# warm-starting a search with garbage trees. Format-1 snapshots (raw
+# Population lists) remain loadable.
+CHECKPOINT_FORMAT = 2
+
+
+class CheckpointError(ValueError):
+    """A snapshot that cannot be trusted: torn/truncated pickle, wrong
+    payload type, or a flat-IR invariant violation (the message names the
+    violated invariant, e.g. ``[postorder] tree 3 slot 5: ...``)."""
 
 # string_tree's complex-constant rendering: "(Re±Imim)", e.g. "(2-0.5im)",
 # "(1e+03+2.5e-05im)". Unambiguous vs infix binaries, which always have
@@ -264,6 +281,164 @@ def options_fingerprint(options) -> tuple:
     )
 
 
+class _OpsetBounds(NamedTuple):
+    """Duck-typed opset stand-in for verify_flat_trees' op-range checks,
+    rebuilt from the snapshot's own operator counts (the real OperatorSet is
+    not picklable and not needed to decode)."""
+
+    n_binary: int
+    n_unary: int
+
+
+@dataclasses.dataclass
+class FlatPopulations:
+    """Snapshot populations as ONE flat postorder batch (format 2).
+
+    Tree arrays follow the :class:`~..ops.flat.FlatTrees` layout over all
+    members of all populations concatenated; ``pop_sizes`` rebuilds the
+    population boundaries and the per-member arrays carry the PopMember
+    metadata (``complexity`` uses -1 for "not computed"). ``val`` is float64
+    — complex128 when any constant is complex — so a decode-encode round
+    trip is bit-exact and resume stays lockstep-identical."""
+
+    kind: np.ndarray
+    op: np.ndarray
+    lhs: np.ndarray
+    rhs: np.ndarray
+    feat: np.ndarray
+    val: np.ndarray
+    length: np.ndarray
+    score: np.ndarray
+    loss: np.ndarray
+    complexity: np.ndarray
+    ref: np.ndarray
+    parent: np.ndarray
+    birth: np.ndarray
+    pop_sizes: list
+    n_binary: int = -1  # -1 = unknown: op-range checks are skipped on load
+    n_unary: int = -1
+
+
+def _scan_tree(tree):
+    """(node count, has complex constant) — or None when the tree shares
+    subtrees (graph_nodes DAGs): flat postorder would silently duplicate
+    shared nodes, so those snapshots keep raw Population pickling."""
+    size = 0
+    has_complex = False
+    seen = set()
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            return None
+        seen.add(id(node))
+        size += 1
+        if node.degree == 0 and node.is_const and isinstance(node.val, complex):
+            has_complex = True
+        if node.degree >= 1:
+            stack.append(node.l)
+        if node.degree == 2:
+            stack.append(node.r)
+    return size, has_complex
+
+
+def flatten_populations(populations, fingerprint=()) -> "FlatPopulations | None":
+    """Flat-encode a list of Populations for a format-2 snapshot. Returns
+    None when any tree is a DAG (caller falls back to raw pickling).
+    ``fingerprint`` (options_fingerprint) supplies the operator counts for
+    the op-range checks on load."""
+    from ..ops.flat import flatten_trees
+
+    members = [m for pop in populations for m in pop.members]
+    sizes = []
+    has_complex = False
+    for m in members:
+        scan = _scan_tree(m.tree)
+        if scan is None:
+            return None
+        sizes.append(scan[0])
+        has_complex = has_complex or scan[1]
+    max_nodes = max(sizes, default=1)
+    dtype = np.complex128 if has_complex else np.float64
+    flat = flatten_trees([m.tree for m in members], max_nodes, dtype=dtype)
+    n_binary = len(fingerprint[0]) if fingerprint else -1
+    n_unary = len(fingerprint[1]) if fingerprint else -1
+    return FlatPopulations(
+        kind=flat.kind, op=flat.op, lhs=flat.lhs, rhs=flat.rhs,
+        feat=flat.feat, val=flat.val, length=flat.length,
+        score=np.asarray([m.score for m in members], np.float64),
+        loss=np.asarray([m.loss for m in members], np.float64),
+        complexity=np.asarray(
+            [-1 if m.complexity is None else int(m.complexity) for m in members],
+            np.int64,
+        ),
+        ref=np.asarray([m.ref for m in members], np.int64),
+        parent=np.asarray([m.parent for m in members], np.int64),
+        birth=np.asarray([m.birth for m in members], np.int64),
+        pop_sizes=[len(pop.members) for pop in populations],
+        n_binary=n_binary,
+        n_unary=n_unary,
+    )
+
+
+def restore_populations(flat: FlatPopulations):
+    """Verify a FlatPopulations payload against every flat-IR invariant and
+    decode it back into Populations of PopMembers. Decoding goes through
+    ``PopMember.__new__`` (the ``copy()`` pattern): birth/ref come from the
+    snapshot, so the global counters are not burned and a bit-exact resume
+    keeps the exact id stream. Raises :class:`CheckpointError` naming the
+    violated invariant on corruption."""
+    from ..analysis.ir_verify import FlatIRError, verify_flat_trees
+    from ..models.pop_member import PopMember
+    from ..models.population import Population
+    from ..ops.flat import FlatTrees, unflatten_tree
+
+    ft = FlatTrees(
+        flat.kind, flat.op, flat.lhs, flat.rhs, flat.feat, flat.val, flat.length
+    )
+    bounds = (
+        _OpsetBounds(int(flat.n_binary), int(flat.n_unary))
+        if int(flat.n_binary) >= 0
+        else None
+    )
+    try:
+        # every stored member has a real tree: empty rows are corruption
+        verify_flat_trees(
+            ft, bounds, allow_empty=False, where="checkpoint populations: "
+        )
+    except FlatIRError as e:
+        raise CheckpointError(
+            f"snapshot populations failed flat-IR verification: {e}"
+        ) from e
+    P = np.asarray(flat.kind).shape[0]
+    meta = (flat.score, flat.loss, flat.complexity, flat.ref, flat.parent, flat.birth)
+    if int(sum(flat.pop_sizes)) != P or any(
+        np.asarray(a).shape != (P,) for a in meta
+    ):
+        raise CheckpointError(
+            f"[shape] snapshot member metadata inconsistent: sum(pop_sizes)="
+            f"{int(sum(flat.pop_sizes))}, trees={P}"
+        )
+    pops = []
+    i = 0
+    for size in flat.pop_sizes:
+        members = []
+        for _ in range(int(size)):
+            m = PopMember.__new__(PopMember)
+            m.tree = unflatten_tree(ft, i)
+            m.score = float(flat.score[i])
+            m.loss = float(flat.loss[i])
+            m.birth = int(flat.birth[i])
+            c = int(flat.complexity[i])
+            m.complexity = None if c < 0 else c
+            m.ref = int(flat.ref[i])
+            m.parent = int(flat.parent[i])
+            members.append(m)
+            i += 1
+        pops.append(Population(members))
+    return pops
+
+
 @dataclasses.dataclass
 class SearchCheckpoint:
     """One full-state snapshot of a running search.
@@ -319,7 +494,13 @@ def latest_checkpoint(base: str) -> str | None:
 
 def load_checkpoint(path: str) -> SearchCheckpoint:
     """Load a snapshot. ``path`` may be a snapshot file or a checkpoint base
-    (``Options.checkpoint_file``), in which case the newest snapshot wins."""
+    (``Options.checkpoint_file``), in which case the newest snapshot wins.
+
+    Format-2 snapshots carry flat-encoded populations: these are verified
+    against every documented flat-IR invariant and decoded back into
+    Populations here — a corrupted/truncated snapshot raises
+    :class:`CheckpointError` naming the violated invariant instead of
+    warm-starting a search with garbage trees."""
     target = path
     if not os.path.isfile(target):
         latest = latest_checkpoint(path)
@@ -328,10 +509,29 @@ def load_checkpoint(path: str) -> SearchCheckpoint:
                 f"no checkpoint at {path!r} (nor any {path}.NNNNNN snapshot)"
             )
         target = latest
-    with open(target, "rb") as f:
-        ckpt = pickle.load(f)
+    try:
+        with open(target, "rb") as f:
+            ckpt = pickle.load(f)
+    except (
+        pickle.PickleError,
+        EOFError,
+        AttributeError,
+        ImportError,
+        IndexError,
+        ValueError,
+        TypeError,
+        UnicodeDecodeError,
+    ) as e:
+        raise CheckpointError(
+            f"cannot unpickle snapshot {target!r}: truncated or corrupt ({e})"
+        ) from e
     if not isinstance(ckpt, SearchCheckpoint):
-        raise ValueError(f"{target!r} is not a SearchCheckpoint snapshot")
+        raise CheckpointError(f"{target!r} is not a SearchCheckpoint snapshot")
+    if isinstance(ckpt.populations, FlatPopulations):
+        try:
+            ckpt.populations = restore_populations(ckpt.populations)
+        except CheckpointError as e:
+            raise CheckpointError(f"snapshot {target!r}: {e}") from e
     return ckpt
 
 
@@ -396,6 +596,16 @@ class SearchCheckpointer:
     def save(self, ckpt: SearchCheckpoint) -> str:
         from . import faults
 
+        # format 2: flat-encode the populations (verified on load). DAG trees
+        # (graph_nodes shared subtrees) keep the format-1 raw pickling.
+        if isinstance(ckpt.populations, list):
+            flat = flatten_populations(
+                ckpt.populations, ckpt.options_fingerprint
+            )
+            if flat is not None:
+                ckpt = dataclasses.replace(
+                    ckpt, populations=flat, format_version=CHECKPOINT_FORMAT
+                )
         path = f"{self.base}.{self._seq:06d}"
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:

@@ -1,0 +1,27 @@
+"""SRL001 clean twin: lax.cond / static-shape branches only."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f(x):
+    return jax.lax.cond(x > 0, jnp.sqrt, lambda v: -v, x)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def h(x, mode):
+    if mode == "fast":  # static argument: concrete at trace time
+        return x * 2
+    if x.shape[0] > 4:  # shape metadata is static
+        return x[:4]
+    return x
+
+
+def g(carry, x):
+    return carry + x, x
+
+
+def run(xs):
+    return jax.lax.scan(g, 0.0, xs)

@@ -1,0 +1,20 @@
+"""SRL001 violation: Python branch on a traced value inside a jitted body."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def f(x):
+    if x > 0:  # EXPECT: SRL001
+        return jnp.sqrt(x)
+    return -x
+
+
+def g(carry, x):
+    while x < 3:  # EXPECT: SRL001
+        x = x + 1
+    return carry, x
+
+
+def run(xs):
+    return jax.lax.scan(g, 0.0, xs)

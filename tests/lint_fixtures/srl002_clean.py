@@ -1,0 +1,11 @@
+"""SRL002 clean twin: jnp on tracers; np only on static metadata."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def f(x):
+    y = jnp.exp(x)
+    scale = np.float32(len(x.shape))  # static: shape metadata only
+    return y * scale

@@ -1,0 +1,11 @@
+"""SRL002 violation: numpy/math applied to traced values inside jit."""
+import math
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def f(x):
+    y = np.exp(x)  # EXPECT: SRL002
+    return y + math.sin(x)  # EXPECT: SRL002

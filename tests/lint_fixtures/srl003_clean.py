@@ -1,0 +1,18 @@
+"""SRL003 clean twin: syncs outside the loop / outside hot-path functions."""
+import numpy as np
+
+
+def device_search_one_output(state, niterations):
+    for it in range(niterations):
+        rb = state.step()
+        rb.copy_to_host_async()  # async: no blocking sync
+        flags = np.asarray([1, 2, 3])  # literal host data, no device transfer
+    final = np.asarray(rb)  # after the loop: one deliberate sync
+    return final.sum() + flags.sum()
+
+
+def cold_helper(rb):
+    # not a hot-path function: syncs here are fine
+    for _ in range(2):
+        buf = np.asarray(rb)
+    return buf

@@ -1,0 +1,16 @@
+"""SRL003 violation: blocking host syncs inside an engine-loop hot path.
+
+The hot-path function names come from lint.HOT_PATH_FUNCTIONS.
+"""
+import numpy as np
+
+
+def device_search_one_output(state, niterations):
+    total = 0.0
+    for it in range(niterations):
+        rb = state.step()
+        buf = np.asarray(rb)  # EXPECT: SRL003
+        total += buf.sum()
+        total += rb.mean().item()  # EXPECT: SRL003
+        rb.block_until_ready()  # EXPECT: SRL003
+    return total

@@ -1,0 +1,16 @@
+"""SRL004 clean twin: env read at build time, baked into the build call."""
+import os
+
+import jax
+
+_FAST = os.environ.get("SR_FAST", "0") == "1"
+
+
+def build():
+    scale = float(os.getenv("SR_SCALE", "1.0"))
+
+    @jax.jit
+    def f(x):
+        return x * (2 if _FAST else 1) * scale
+
+    return f

@@ -1,0 +1,12 @@
+"""SRL004 violation: env reads inside a traced body (frozen at trace time)."""
+import os
+
+import jax
+
+
+@jax.jit
+def f(x):
+    if os.environ.get("SR_FAST", "0") == "1":  # EXPECT: SRL004
+        return x * 2
+    scale = float(os.getenv("SR_SCALE", "1.0"))  # EXPECT: SRL004
+    return x * scale

@@ -1,0 +1,18 @@
+"""SRL005 clean twin: the key is rebound by the split, halves consumed."""
+import jax
+
+
+def sample(key, shape):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, shape)
+    b = jax.random.normal(key, shape)  # key was rebound: fresh stream
+    return a + b
+
+
+def fan_out(key, n):
+    # consuming a key by splitting it into lane keys, never touching it again,
+    # is the idiomatic pattern (ops/evolve.py does this per iteration)
+    lanes = jax.random.split(
+        key, n
+    )
+    return lanes

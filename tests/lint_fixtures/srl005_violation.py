@@ -1,0 +1,9 @@
+"""SRL005 violation: PRNG key reused after jax.random.split."""
+import jax
+
+
+def sample(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.normal(key, shape)  # EXPECT: SRL005
+    return a + b + jax.random.uniform(k2)

@@ -1,0 +1,8 @@
+"""SRL006 clean twin: the donated name is rebound before any later read."""
+import jax
+
+
+def step_loop(state, xs):
+    step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+    state = step(state, xs)
+    return state, state.sum()  # rebound: reads the NEW buffer

@@ -1,0 +1,9 @@
+"""SRL006 violation: donated buffer read after the donating call."""
+import jax
+
+
+def step_loop(state, xs):
+    step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+    new_state = step(state, xs)
+    stale = state.sum()  # EXPECT: SRL006
+    return new_state, stale

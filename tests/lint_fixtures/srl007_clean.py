@@ -1,0 +1,19 @@
+"""SRL007 clean twin: the key carries every Options field the body reads,
+including reads made through a module-local builder (the r06 fix)."""
+
+_CACHE = {}
+
+
+def _build_const_opt(options, n_slots):
+    objective = options.loss_function_jit
+    g_tol = options.optimizer_g_tol
+    return ("compiled", objective, g_tol, n_slots)
+
+
+def get_const_opt_fn(options, n_slots):
+    key = (n_slots, options.optimizer_g_tol, options.loss_function_jit)
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _build_const_opt(options, n_slots)
+        _CACHE[key] = fn
+    return fn

@@ -1,0 +1,22 @@
+"""SRL007 violation: minimized r06 incident — the compile-cache key omits an
+Options field the cached body reads. A second search with a different
+``loss_function_jit`` silently reuses the first search's compiled const-opt
+objective."""
+
+_CACHE = {}
+
+
+def _build_const_opt(options, n_slots):
+    # reads options.loss_function_jit and options.optimizer_g_tol
+    objective = options.loss_function_jit
+    g_tol = options.optimizer_g_tol
+    return ("compiled", objective, g_tol, n_slots)
+
+
+def get_const_opt_fn(options, n_slots):
+    key = (n_slots, options.optimizer_g_tol)  # EXPECT: SRL007
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _build_const_opt(options, n_slots)
+        _CACHE[key] = fn
+    return fn

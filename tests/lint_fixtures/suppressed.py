@@ -1,0 +1,14 @@
+"""Suppression fixture: the same SRL001/SRL004 violations as the violation
+corpus, silenced with `# srl: disable=` pragmas (trailing and standalone)."""
+import os
+
+import jax
+
+
+@jax.jit
+def f(x):
+    if x > 0:  # srl: disable=SRL001 -- exercised by tests, known-static in practice
+        return x * 2
+    # srl: disable=SRL004 -- standalone pragma applies to the next line
+    flag = os.environ.get("SR_FAST", "0")
+    return x, flag

@@ -1,0 +1,138 @@
+"""sr-lint: fixture-corpus coverage for every rule id.
+
+Each ``tests/lint_fixtures/srlNNN_violation.py`` carries ``# EXPECT: SRLNNN``
+markers on the exact lines its rule must fire on; the ``srlNNN_clean.py``
+twin must stay silent. ``suppressed.py`` proves the ``# srl: disable=``
+pragma (trailing and standalone forms) silences findings without hiding them
+from ``--show-suppressed``. Finally the merged package tree itself must lint
+clean — the CI gate this PR turns on.
+"""
+
+import importlib.util
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+LINT_PY = os.path.join(REPO, "symbolicregression_jl_tpu", "analysis", "lint.py")
+
+RULE_IDS = ["SRL001", "SRL002", "SRL003", "SRL004", "SRL005", "SRL006", "SRL007"]
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location("sr_lint_test_impl", LINT_PY)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["sr_lint_test_impl"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint = _load_lint()
+
+
+def _expected_lines(path: str) -> dict[int, str]:
+    """line -> rule id, from # EXPECT: SRLNNN markers."""
+    out = {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            m = re.search(r"#\s*EXPECT:\s*(SRL\d+)", line)
+            if m:
+                out[lineno] = m.group(1)
+    return out
+
+
+def test_stdlib_only():
+    """The lint module must stay loadable without JAX (the CI lint job runs
+    in a bare environment): it may import nothing outside the stdlib."""
+    import ast
+
+    tree = ast.parse(open(LINT_PY).read())
+    top_imports = set()
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            top_imports |= {a.name.split(".")[0] for a in node.names}
+        elif isinstance(node, ast.ImportFrom):
+            top_imports.add((node.module or "").split(".")[0])
+    assert top_imports <= {
+        "ast", "dataclasses", "io", "json", "os", "tokenize", "__future__",
+    }, f"non-stdlib import crept into lint.py: {top_imports}"
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_fires_exactly_where_expected(rule):
+    path = os.path.join(FIXTURES, f"{rule.lower()}_violation.py")
+    expected = _expected_lines(path)
+    assert expected, f"{path} has no EXPECT markers"
+    findings = [f for f in lint.lint_file(path) if f.rule == rule]
+    got = {f.line for f in findings}
+    want = {ln for ln, rid in expected.items() if rid == rule}
+    assert got == want, (
+        f"{rule}: expected findings on lines {sorted(want)}, got "
+        f"{sorted(got)}: {[f.render() for f in lint.lint_file(path)]}"
+    )
+    # no OTHER rule fires on the violation snippet either (one rule per file)
+    other = [f for f in lint.lint_file(path) if f.rule != rule]
+    assert not other, [f.render() for f in other]
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_clean_twin_is_silent(rule):
+    path = os.path.join(FIXTURES, f"{rule.lower()}_clean.py")
+    findings = lint.lint_file(path)
+    assert not findings, [f.render() for f in findings]
+
+
+def test_srl007_reproduces_r06_stale_key_miss():
+    """The cache-key rule must name the exact omitted field of the minimized
+    r06 incident (k_copt missing loss_function_jit)."""
+    path = os.path.join(FIXTURES, "srl007_violation.py")
+    [f] = [f for f in lint.lint_file(path) if f.rule == "SRL007"]
+    assert "loss_function_jit" in f.message
+
+
+def test_suppression_silences_and_records_reason():
+    path = os.path.join(FIXTURES, "suppressed.py")
+    findings = lint.lint_file(path)
+    assert findings, "suppressed fixture should still produce findings"
+    assert all(f.suppressed for f in findings), [f.render() for f in findings]
+    by_rule = {f.rule: f for f in findings}
+    assert set(by_rule) == {"SRL001", "SRL004"}
+    assert by_rule["SRL001"].reason  # trailing pragma carries its reason
+    # standalone pragma on the previous line applies to the next line
+    assert by_rule["SRL004"].line == 13
+
+
+def test_package_tree_lints_clean():
+    """The merged tree has zero unsuppressed findings — the CI lint gate."""
+    pkg = os.path.join(REPO, "symbolicregression_jl_tpu")
+    findings = [f for f in lint.lint_paths([pkg]) if not f.suppressed]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_cli_exit_codes_and_json():
+    env = dict(os.environ)
+    cli = os.path.join(REPO, "scripts", "sr_lint.py")
+    bad = os.path.join(FIXTURES, "srl001_violation.py")
+    ok = os.path.join(FIXTURES, "srl001_clean.py")
+    r = subprocess.run(
+        [sys.executable, cli, "--json", bad], capture_output=True, text=True,
+        env=env,
+    )
+    assert r.returncode == 1
+    import json
+
+    payload = json.loads(r.stdout)
+    assert any(f["rule"] == "SRL001" for f in payload)
+    r = subprocess.run(
+        [sys.executable, cli, ok], capture_output=True, text=True, env=env
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, cli, "--list-rules"], capture_output=True, text=True,
+        env=env,
+    )
+    assert r.returncode == 0 and "SRL007" in r.stdout
