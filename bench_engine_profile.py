@@ -22,6 +22,13 @@ compaction, no convergence gate) so the artifact carries a like-for-like
 const_opt stage comparison against both the in-run legacy baseline and the
 committed r06 reference numbers.
 
+Round 10: the default engine now runs the fused per-iteration megaprogram
+(SR_FUSED_ITER, evolve → const-opt → finalize in ONE dispatch; the profile
+reports a ``fused_iter`` stage decomposed by probe fractions into
+``fused_iter/<leg>`` sub-timings). ``--ab`` pins the baseline run to the
+r07-era compat engine (``SR_FUSED_ITER=0 SR_COPT_COMPAT=1``: split dispatch
+chain + legacy const-opt) and reports the end-to-end iteration_mean speedup.
+
 Usage::
 
     JAX_PLATFORMS=cpu python bench_engine_profile.py --niterations 4
@@ -177,28 +184,47 @@ def main():
     # dispatch, fixed-iteration scan) as the in-run baseline
     const_opt_ab = None
     if args.ab or args.tiny:
+        # r07-era compat engine: split per-stage dispatch chain + legacy
+        # const-opt — the like-for-like baseline for the fused megaprogram
         os.environ["SR_COPT_COMPAT"] = "1"
+        os.environ["SR_FUSED_ITER"] = "0"
         try:
             res_c, _ = _run_search(X, y, kwargs, n_prof, profile=True)
         finally:
             del os.environ["SR_COPT_COMPAT"]
+            del os.environ["SR_FUSED_ITER"]
         prof_c = res_c.engine_profile
         ms_base = prof_c["stages"].get("const_opt", {}).get("mean_ms", 0.0)
-        ms_new = profile["stages"].get("const_opt", {}).get("mean_ms", 0.0)
+        # fused runs report const-opt as a probe-fraction sub-timing of the
+        # single fused_iter dispatch; split runs as their own stage
+        ms_new = (
+            profile["stages"].get("const_opt", {}).get("mean_ms", 0.0)
+            or profile["stages"].get("fused_iter/const_opt", {}).get("mean_ms", 0.0)
+        )
+        it_base = prof_c.get("iteration_mean_ms", 0.0)
+        it_new = profile.get("iteration_mean_ms", 0.0)
         const_opt_ab = {
             "baseline_compat": {
-                "iteration_mean_ms": prof_c.get("iteration_mean_ms"),
+                "gates": {"SR_COPT_COMPAT": "1", "SR_FUSED_ITER": "0"},
+                "iteration_mean_ms": it_base,
                 "stages": prof_c["stages"],
                 "best_loss": float(min(m.loss for m in res_c.pareto_frontier)),
             },
             "new_best_loss": float(min(m.loss for m in res_p.pareto_frontier)),
             "const_opt_mean_ms": {"baseline_compat": ms_base, "new": ms_new},
             "const_opt_speedup_in_run": round(ms_base / max(ms_new, 1e-9), 4),
+            "iteration_mean_ms": {"baseline_compat": it_base, "new": it_new},
+            "iteration_speedup_fused_over_compat": round(
+                it_base / max(it_new, 1e-9), 4
+            ),
         }
 
     # 2) scoring share inside the fused evolve program
     probe = _scoring_probe(X, y, options, args.niterations)
-    evolve_ms = profile["stages"].get("evolve", {}).get("mean_ms", 0.0)
+    evolve_ms = (
+        profile["stages"].get("evolve", {}).get("mean_ms", 0.0)
+        or profile["stages"].get("fused_iter/evolve", {}).get("mean_ms", 0.0)
+    )
     if evolve_ms > 0:
         probe["fraction_of_evolve_stage"] = round(
             probe["scoring_ms_per_iteration_est"] / evolve_ms, 4

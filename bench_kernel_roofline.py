@@ -221,8 +221,88 @@ def rows_sweep(P_sweep: int = 512):
     return rows_out
 
 
+def engine_mode(niterations: int = 4, R_e: int = 10_240):
+    """IN-ENGINE utilization (round 10): the chain-K synthetic above measures
+    what the kernel can do; this measures what the ENGINE actually sustains —
+    a real device search (fused megaprogram + in-engine Pallas scoring under
+    the default gates), with utilization derived from the engine's own eval
+    accounting rather than a synthetic invocation chain.
+
+    row_evals/s = num_evals x n_rows / loop_s (num_evals already counts
+    fractional batched evals, and this config runs unbatched so every eval
+    sweeps all rows); useful flops ~= row_evals/s x mean live nodes, the same
+    1-flop-per-(tree,slot,row) convention as the roofline. The 2.2% chain-K
+    utilization number (ROOFLINE_r05) finally gets an engine-side data point.
+
+    On CPU hosts the line is still emitted (structure/CI) but utilization is
+    reported against the v5e VPU peak and is only meaningful on TPU."""
+    import jax
+
+    from symbolicregression_jl_tpu import Options, equation_search
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, R_e)).astype(np.float32)
+    y = np.cos(X[0]).astype(np.float32)
+    platform = jax.devices()[0].platform
+    scale = 1 if platform == "tpu" else 4  # CPU: same structure, less work
+    opts = Options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp", "abs"],
+        maxsize=N,
+        populations=max(2, 8 // scale),
+        population_size=max(8, 40 // scale),
+        ncycles_per_iteration=max(8, 80 // scale),
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    res = equation_search(
+        X, y, options=opts, niterations=niterations, verbosity=0
+    )
+    mean_nodes = float(
+        np.mean(
+            [
+                m.tree.count_nodes()
+                for p in res.populations
+                for m in p.members
+            ]
+        )
+    )
+    row_evals_per_sec = res.num_evals * R_e / max(res.iteration_seconds, 1e-9)
+    useful_flops = row_evals_per_sec * mean_nodes
+    print(
+        json.dumps(
+            {
+                "metric": "engine_utilization",
+                "platform": platform,
+                "n_rows": R_e,
+                "niterations": niterations,
+                "populations": opts.populations,
+                "population_size": opts.population_size,
+                "ncycles_per_iteration": opts.ncycles_per_iteration,
+                "SR_FUSED_ITER": os.environ.get("SR_FUSED_ITER", "1"),
+                "SR_ENGINE_PALLAS": os.environ.get("SR_ENGINE_PALLAS", "1"),
+                "num_evals": float(res.num_evals),
+                "loop_s": round(res.iteration_seconds, 3),
+                "tree_evals_per_sec": round(
+                    res.num_evals / max(res.iteration_seconds, 1e-9), 1
+                ),
+                "row_evals_per_sec": round(row_evals_per_sec, 0),
+                "mean_live_nodes": round(mean_nodes, 2),
+                "vpu_utilization_in_engine": round(
+                    useful_flops / V5E_VPU_FLOPS, 4
+                ),
+                "timing": "whole engine loop (dispatch + host legs included)",
+            }
+        ),
+        flush=True,
+    )
+
+
 if __name__ == "__main__":
     if "--rows-sweep" in sys.argv:
         rows_sweep()
+    elif "--engine" in sys.argv:
+        engine_mode()
     else:
         main()

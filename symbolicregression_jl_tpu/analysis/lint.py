@@ -24,6 +24,9 @@ SRL005      PRNG key reused after ``jax.random.split`` (without rebinding)
 SRL006      donated buffer read after the donating call
 SRL007      compile-cache key misses an ``Options`` field its cached body
             reads (the r06 ``k_copt`` class)
+SRL008      one-shot Pallas host packing (``loss_trees_pallas`` /
+            ``batched_loss_jit(use_pallas=True)``) inside an engine hot loop
+            (hot loops must hold a ``make_pallas_loss_fn`` closure)
 ==========  ==================================================================
 
 Suppressions: a trailing ``# srl: disable=SRL001[,SRL002] [-- reason]``
@@ -83,6 +86,14 @@ RULES = {
         "compiled-function cache key omits an Options field the cached "
         "body reads — a second search with a different value for that field "
         "silently reuses the stale executable (the r06 k_copt incident)",
+    ),
+    "SRL008": (
+        "pallas-pack-in-hot-loop",
+        "host-side Pallas packing (loss_trees_pallas / "
+        "batched_loss_jit(use_pallas=True)) inside an engine hot loop — "
+        "these are one-shot conveniences that re-pack the batch on the host "
+        "every call; hot loops MUST hold a make_pallas_loss_fn closure "
+        "(ops/scoring.py contract, promoted to a rule in r10)",
     ),
 }
 
@@ -467,6 +478,52 @@ def _check_hot_sync(tree, path, findings):
                 ))
 
 
+#: one-shot host-packing entry points the SRL008 contract bans from hot loops
+#: (ops/scoring.py: "one-shot only; hot loops MUST hold make_pallas_loss_fn")
+PALLAS_ONESHOT_FUNCS = {"loss_trees_pallas", "loss_trees_pallas_batch"}
+
+
+def _check_pallas_hot_packing(tree, path, findings):
+    """SRL008: one-shot Pallas packing helpers called inside loops of
+    engine-driver functions. ``loss_trees_pallas*`` is flagged outright;
+    ``batched_loss_jit`` only when called with ``use_pallas=True`` (a literal
+    — a Name flowing in is assumed build-time config, like the other rules'
+    conservative literal policy)."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, _FUNC_DEFS) or fn.name not in HOT_PATH_FUNCTIONS:
+            continue
+        loops = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.For | ast.While) and _enclosing_function(n) is fn
+        ]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not any(_inside(node, lp) for lp in loops):
+                continue
+            name = _tail(_dotted(node.func))
+            bad = None
+            if name in PALLAS_ONESHOT_FUNCS:
+                bad = f"{name}(...)"
+            elif name == "batched_loss_jit":
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "use_pallas"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    ):
+                        bad = "batched_loss_jit(use_pallas=True)"
+            if bad:
+                findings.append(Finding(
+                    "SRL008", path, node.lineno, node.col_offset,
+                    f"one-shot Pallas packing {bad} inside the `{fn.name}` "
+                    "engine loop — re-packs the batch on the host every "
+                    "call; build a make_pallas_loss_fn closure once outside "
+                    "the loop",
+                ))
+
+
 def _split_key_arg(node: ast.Call) -> str | None:
     """`jax.random.split(key[, n])` -> 'key' when arg0 is a plain Name."""
     if _tail(_dotted(node.func)) != "split":
@@ -790,6 +847,7 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     findings: list[Finding] = []
     _check_traced_rules(tree, path, findings)
     _check_hot_sync(tree, path, findings)
+    _check_pallas_hot_packing(tree, path, findings)
     _check_key_reuse(tree, path, findings)
     _check_donated_reuse(tree, path, findings)
     _check_cache_keys(tree, path, findings)

@@ -273,6 +273,33 @@ _SCORE_DATA_CACHE: dict = {}
 _CACHE_LOCK = threading.Lock()  # concurrent per-output searches share caches
 
 
+def _cache_get_lru(cache: dict, key):
+    """LRU hit: dicts preserve insertion order and eviction pops the FIRST
+    entry, so a hit must re-insert its key at the end — without this,
+    alternating between >2 configs under a full cache evicts the hot entry
+    every time (cap-12 FIFO was measured doing exactly that). Caller holds
+    _CACHE_LOCK."""
+    val = cache.get(key)
+    if val is not None:
+        cache[key] = cache.pop(key)
+    return val
+
+
+def _engine_pallas_enabled() -> bool:
+    """SR_ENGINE_PALLAS gate (default ON): bucket-sized Pallas dispatch for
+    the in-engine score fn. ``=0`` recovers the exact r07 full-N kernel call.
+    Read at BUILD time only and baked into the score-fn cache key (SRL004:
+    never read env inside traced code)."""
+    return os.environ.get("SR_ENGINE_PALLAS", "1") != "0"
+
+
+def _pallas_interpret() -> bool:
+    # lazy import: keep module import light (matches local-import idiom)
+    from ..ops.interp_pallas import pallas_interpret_enabled
+
+    return pallas_interpret_enabled()
+
+
 def _dataset_key(X, y, weights):
     """Content key for the memoization caches (computed ONCE per search —
     tobytes() copies the arrays, so don't rebuild it per consumer). Shape
@@ -329,9 +356,11 @@ def _make_score_fn(
         # searches must not reuse it
         length_buckets_enabled(),
         bucket_min(),
+        _engine_pallas_enabled(),
+        use_pallas and _pallas_interpret(),
     )
     with _CACHE_LOCK:
-        fn = _SCORE_FN_CACHE.get(fn_key)
+        fn = _cache_get_lru(_SCORE_FN_CACHE, fn_key)
     if fn is None:
         n_local = X.shape[1] // rows_shards if rows_shards > 1 else X.shape[1]
         fn = _build_score_fn(
@@ -355,7 +384,7 @@ def _make_score_fn(
         rows_shards,
     )
     with _CACHE_LOCK:
-        data = _SCORE_DATA_CACHE.get(d_key)
+        data = _cache_get_lru(_SCORE_DATA_CACHE, d_key)
     if data is None:
         if rows_shards > 1:
             data = _make_score_data_rows(
@@ -544,36 +573,85 @@ def _build_score_fn(
             _loss_pallas_dyn,
             _round_up,
             pack_batch_jnp,
+            pallas_interpret_enabled,
         )
 
         C = _round_up(n_rows, 8 * C_TILE) // 8
-        Lv = _round_up(N, 128)
+        interpret = pallas_interpret_enabled()
+        bsizes = bucket_sizes(N)
+        # SR_ENGINE_PALLAS (default on): bucket-sized kernel dispatch via the
+        # r07 length ladder — the kernel's per-slot program loop dominates,
+        # so a generation whose longest tree fits a small bucket skips the
+        # dead slot tail instead of burning VPU cycles on zeros. =0 recovers
+        # the exact r07 full-N launch (baked into the _SCORE_FN_CACHE key).
+        pl_bucketed = (
+            _engine_pallas_enabled()
+            and length_buckets_enabled()
+            and len(bsizes) > 1
+        )
 
-        def score_fn(batch, data: ScoreData, key=None):
+        def _pack_pad(batch, n_b):
+            # pack at bucket width n_b; truncation is bit-exact (flat-IR
+            # invariant: pad slots hold exact zeros and are never read)
             B = batch.kind.shape[0]
             B_pad = _round_up(B, P_TILE_LOSS)
+            Lv_b = _round_up(n_b, 128)
             ints = pack_batch_jnp(
-                batch.kind, batch.op, batch.lhs, batch.rhs, batch.feat,
-                batch.length, opset,
+                batch.kind[:, :n_b], batch.op[:, :n_b], batch.lhs[:, :n_b],
+                batch.rhs[:, :n_b], batch.feat[:, :n_b], batch.length, opset,
             )
-            vals = jnp.pad(batch.val.astype(jnp.float32), ((0, 0), (0, Lv - N)))
+            vals = jnp.pad(
+                batch.val[:, :n_b].astype(jnp.float32),
+                ((0, 0), (0, Lv_b - n_b)),
+            )
             if B_pad != B:  # pad with copies of row 0 (must be a VALID tree)
                 ints = jnp.concatenate(
                     [ints, jnp.broadcast_to(ints[:1], (B_pad - B, ints.shape[1]))],
                     axis=0,
                 )
                 vals = jnp.concatenate(
-                    [vals, jnp.broadcast_to(vals[:1], (B_pad - B, Lv))], axis=0
+                    [vals, jnp.broadcast_to(vals[:1], (B_pad - B, Lv_b))], axis=0
                 )
+            return ints, vals
+
+        def _loss_full(batch, data, n_b):
+            ints, vals = _pack_pad(batch, n_b)
+            return _loss_pallas(
+                ints, vals, data.Xr, data.yr, data.wr, opset, loss_elem,
+                n_b, P_TILE_LOSS, C_TILE, C, n_rows, interpret=interpret,
+            )
+
+        def score_fn(batch, data: ScoreData, key=None):
+            B = batch.kind.shape[0]
             if key is None:
-                out = _loss_pallas(
-                    ints, vals, data.Xr, data.yr, data.wr, opset, loss_elem,
-                    N, P_TILE_LOSS, C_TILE, C, n_rows,
-                )
+                if pl_bucketed:
+                    # score_fn is never called under vmap (see _eval_bucketed
+                    # below), so the switch stays a real runtime branch
+                    bidx = jnp.searchsorted(
+                        jnp.asarray(bsizes, jnp.int32), jnp.max(batch.length)
+                    )
+                    out = lax.switch(
+                        bidx,
+                        [
+                            (
+                                lambda operands, n_b=n_b: _loss_full(
+                                    operands[0], operands[1], n_b
+                                )
+                            )
+                            for n_b in bsizes
+                        ],
+                        (batch, data),
+                    )
+                else:
+                    out = _loss_full(batch, data, N)
                 # wr is 0 on pad rows and the true weight (1 unweighted) on
                 # real rows, so its sum IS this shard's weight total
                 out = _combine(out, jnp.sum(data.wr))
             else:
+                # minibatch form keeps the full-N dynamic-rows kernel: the
+                # gather dominates here and per-bucket variants would
+                # multiply compiled programs for no measured win
+                ints, vals = _pack_pad(batch, N)
                 idx = jax.random.choice(
                     _fold_rows(key), n_rows, (bs,), replace=True
                 )
@@ -581,6 +659,7 @@ def _build_score_fn(
                     ints, vals, data.Xd[:, idx], data.yd[idx],
                     data.wd[idx] if has_w else jnp.zeros((), jnp.float32),
                     opset, loss_elem, N, has_w, bs,
+                    interpret=interpret,
                 )
                 out = _combine(out, _batch_wsum(data, idx))
             return out[:B]
@@ -654,7 +733,7 @@ def _build_score_fn(
 
 def _make_const_opt_fn(
     options: Options, cfg: EvoConfig, has_w: bool, axis=None, rows_axis=None,
-    batch_rows: int | None = None,
+    batch_rows: int | None = None, jit: bool = True,
 ):
     """Jitted per-iteration constant optimization over a fixed-size random
     member subset, fully device-side (selection, BFGS, accept, scatter-back).
@@ -874,7 +953,9 @@ def _make_const_opt_fn(
             axis=axis, norm=data.norm, base_loss=base,
         )
 
-    return const_opt if axis is not None else jax.jit(const_opt)
+    # jit=False hands back the raw traceable impl so the fused iteration
+    # program can inline it (SR_FUSED_ITER) instead of dispatching it
+    return const_opt if (axis is not None or not jit) else jax.jit(const_opt)
 
 
 def _copt_env() -> tuple[bool, bool]:
@@ -1024,7 +1105,7 @@ def _accept_and_scatter(
 
 def _make_const_opt_fn_pallas(
     options: Options, cfg: EvoConfig, n_rows: int, has_w: bool, axis=None,
-    rows_axis=None, batch_rows: int | None = None,
+    rows_axis=None, batch_rows: int | None = None, jit: bool = True,
 ):
     """Constant optimization through the fused Pallas loss+grad kernel
     (ops/interp_pallas._loss_grad_pallas): the whole (member, restart) batch
@@ -1058,9 +1139,9 @@ def _make_const_opt_fn_pallas(
     from ..ops.interp_pallas import (
         C_TILE,
         P_TILE_LOSS,
-        _loss_grad_pallas,
-        _loss_pallas,
         pack_batch_jnp,
+        pallas_diff_loss,
+        pallas_interpret_enabled,
         _round_up,
     )
 
@@ -1079,6 +1160,7 @@ def _make_const_opt_fn_pallas(
     R_eff = n_rows if batch_rows is None else batch_rows
     C = _round_up(R_eff, 8 * C_TILE) // 8
     F = cfg.nfeatures
+    interpret = pallas_interpret_enabled()
 
     def const_opt(state: EvoState, data) -> EvoState:
         # kernel calls take the packed dataset from the traced `data` arg —
@@ -1115,21 +1197,16 @@ def _make_const_opt_fn_pallas(
             def comb(x):
                 return x
 
-        def loss_fn(ints, vals):
-            return comb(
-                _loss_pallas(
-                    ints, vals, Xr, yr, wr, opset, loss_elem,
-                    N, P_TILE_LOSS, C_TILE, C, R_eff,
-                )
+        def dloss(ints, vals):
+            # custom_vjp-differentiable loss (ops/interp_pallas): the primal
+            # is the forward loss kernel; the VJP is ONE fused loss+grad
+            # launch whose forward residual already holds the per-slot
+            # adjoints — nothing re-materializes the interpreter's SSA
+            # buffer through HBM inside the BFGS while_loop
+            return pallas_diff_loss(
+                ints, vals, Xr, yr, wr, opset, loss_elem, N,
+                C=C, R=R_eff, interpret=interpret,
             )
-
-        def grad_fn(ints, vals, _n):
-            vpad = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, Lv - N)))
-            f, g = _loss_grad_pallas(
-                ints, vpad, Xr, yr, wr, opset, loss_elem,
-                N, P_TILE_LOSS, C_TILE, C, R_eff,
-            )
-            return comb(f), comb(g)
 
         key, ii, pp, val0, mask_k, starts = _select_and_jitter(
             state, K, S, I, P, axis=axis, const_aware=not compat,
@@ -1160,13 +1237,15 @@ def _make_const_opt_fn_pallas(
                 [starts, jnp.broadcast_to(starts[:1], (pad, N))]
             )
 
-        def vloss(x):  # [B] losses
+        def vloss(x):  # [B] losses (forward kernel only — line-search evals)
             vpad = jnp.pad(x, ((0, 0), (0, Lv - N)))
-            return loss_fn(ints_b, vpad)
+            return comb(dloss(ints_b, vpad))
 
-        def vgrad(x):  # ([B], [B, N])
-            f, g = grad_fn(ints_b, x, N)
-            return f, jnp.where(mask_b, g, 0.0)
+        def vgrad(x):  # ([B], [B, N]) — in-kernel gradients via custom_vjp
+            vpad = jnp.pad(x, ((0, 0), (0, Lv - N)))
+            f, pull = jax.vjp(lambda v: dloss(ints_b, v), vpad)
+            (g,) = pull(jnp.ones_like(f))
+            return comb(f), jnp.where(mask_b, comb(g[:, :N]), 0.0)
 
         eye = jnp.broadcast_to(jnp.eye(N, dtype=jnp.float32), (B, N, N))
         f0, g0 = vgrad(starts)
@@ -1274,7 +1353,9 @@ def _make_const_opt_fn_pallas(
             n_ev, axis=axis, norm=data.norm, base_loss=base,
         )
 
-    return const_opt if axis is not None else jax.jit(const_opt)
+    # jit=False hands back the raw traceable impl so the fused iteration
+    # program can inline it (SR_FUSED_ITER) instead of dispatching it
+    return const_opt if (axis is not None or not jit) else jax.jit(const_opt)
 
 
 _AOT_CACHE: dict = {}
@@ -1287,6 +1368,61 @@ def _aot_cache_put(key, value):
         if len(_AOT_CACHE) >= 32:
             _AOT_CACHE.pop(next(iter(_AOT_CACHE)))
         _AOT_CACHE[key] = value
+
+
+# test seam: when set to a callable, the engine main loop reports each
+# compiled-program dispatch by name ("fused_iter", "evolve", "const_opt",
+# "finalize", "readback", "pool_extract") — the ≤2-dispatches/iteration
+# invariant of the fused path is asserted through this hook
+_DISPATCH_HOOK = None
+
+
+def _count_dispatch(name: str):
+    hook = _DISPATCH_HOOK
+    if hook is not None:
+        hook(name)
+
+
+def _probe_fused_fractions(
+    state, score_data, ecfg, score_fn, copt_impl, fin_score_fn, repeats=3
+):
+    """Estimate the fused megaprogram's per-leg decomposition by timing each
+    leg as its own (non-donated) program against the live pre-loop state.
+    Returns {leg: fraction} summing to 1. Profiling-mode only: it compiles
+    the split programs once, purely to keep ENGINE_PROFILE artifacts
+    comparable — the reported ``fused_iter/<leg>`` sub-timings are this
+    probe's fractions applied to each iteration's fused wall, not in-program
+    measurements (XLA exposes none inside one executable)."""
+    import jax
+
+    from ..ops.evolve import run_finalize, run_iteration
+
+    legs = [
+        ("evolve", lambda st: run_iteration(st, score_data, ecfg, score_fn))
+    ]
+    if copt_impl is not None:
+        copt_jit = jax.jit(copt_impl)
+        legs.append(("const_opt", lambda st: copt_jit(st, score_data)))
+    if fin_score_fn is not None and ecfg.batching:
+        legs.append(
+            (
+                "finalize",
+                lambda st: run_finalize(st, score_data, ecfg, fin_score_fn),
+            )
+        )
+    times = {}
+    st = state
+    for name, fn in legs:
+        out = jax.block_until_ready(fn(st))  # compile + warm outside the clock
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = jax.block_until_ready(fn(st))
+        times[name] = (time.perf_counter() - t0) / repeats
+        st = out
+    total = sum(times.values())
+    if total <= 0.0:
+        return None
+    return {k: v / total for k, v in times.items()}
 
 
 def _shard_const_opt(mesh, impl, data_specs=None):
@@ -1730,7 +1866,10 @@ def device_search_one_output(
     # interpreter (XLA emulates f64 on TPU — correctness over speed, like
     # the reference's Float64 default path)
     use_pallas = (
-        jax.devices()[0].platform != "cpu"
+        # SR_PALLAS_INTERPRET=1 runs the kernels through the Pallas
+        # interpreter on CPU — slow, but it exercises the exact kernel
+        # dataflow off-TPU (parity tests, CI smoke)
+        (jax.devices()[0].platform != "cpu" or _pallas_interpret())
         and eng_dt == np.float32
         # the fused kernel reduces elementwise loss in-pass; a traceable
         # full objective needs the [B, R] prediction matrix -> interp path
@@ -1783,14 +1922,14 @@ def device_search_one_output(
         has_w = w is not None
         n_rows_local = dataset.n // rows_shards
         if use_pallas_grad:
-            make_copt = lambda c, axis=None: _make_const_opt_fn_pallas(  # noqa: E731
+            make_copt = lambda c, axis=None, jit=True: _make_const_opt_fn_pallas(  # noqa: E731
                 options, c, n_rows_local, has_w, axis=axis,
-                rows_axis=rows_axis, batch_rows=bs_local,
+                rows_axis=rows_axis, batch_rows=bs_local, jit=jit,
             )
         else:
-            make_copt = lambda c, axis=None: _make_const_opt_fn(  # noqa: E731
+            make_copt = lambda c, axis=None, jit=True: _make_const_opt_fn(  # noqa: E731
                 options, c, has_w, axis=axis, rows_axis=rows_axis,
-                batch_rows=bs_local,
+                batch_rows=bs_local, jit=jit,
             )
         if mesh is not None:
             const_opt_fn = _shard_const_opt(
@@ -1814,6 +1953,29 @@ def device_search_one_output(
 
             finalize_fn = lambda st, d: run_finalize(st, d, ecfg, score_fn)  # noqa: E731
     readback_fn = _make_readback_fn(ecfg)
+
+    # --- fused per-iteration megaprogram (SR_FUSED_ITER, default on) --------
+    # evolve -> const-opt -> (batching) full-data finalize chained in ONE
+    # compiled program: the per-iteration device dispatch chain collapses to
+    # fused_iter + readback (<=2 dispatches/iteration). =0 recovers the exact
+    # r07 split chain; unsupported modes fall back automatically (sharded
+    # meshes build shard_map programs per stage, lineage replay consumes
+    # per-program event logs).
+    fused_iter = (
+        os.environ.get("SR_FUSED_ITER", "1") != "0"
+        and mesh is None
+        and not options.use_recorder
+        and not ecfg.record_events
+    )
+    copt_impl = None
+    fin_sfn = None
+    if fused_iter:
+        if const_opt_fn is not None:
+            # the raw traceable const-opt impl — inlined into the fused
+            # trace instead of dispatched as its own program
+            copt_impl = make_copt(ecfg, jit=False)
+        if cfg.batching:
+            fin_sfn = score_fn
 
     # --- initial populations (host trees -> device state) -------------------
     if saved_state is not None:
@@ -1961,7 +2123,40 @@ def device_search_one_output(
     # at steady-state speed (reference precompiles its workload,
     # /root/reference/src/precompile.jl:36-93). lower().compile() builds
     # the executable without running an iteration.
-    if options.jit_warmup:
+    fused_step = None
+    if options.jit_warmup and fused_iter:
+        # AOT key for the fused megaprogram: the union of the k_iter and
+        # k_copt fields below (the fused trace inlines both), plus the
+        # batching/finalize leg and the kernel gates baked into the closures
+        k_fused = (
+            "fused", cfg_local, score_fn, async_rb, cfg.batching,
+            use_pallas_grad, _pallas_interpret(),
+            None
+            if copt_impl is None
+            else (
+                X.shape, w is not None, options.operators, options.loss,
+                options.loss_function_jit,
+                options.optimizer_probability, options.optimizer_nrestarts,
+                options.optimizer_iterations, options.optimizer_algorithm,
+                options.optimizer_g_tol, _copt_env(), bucket_min(),
+            ),
+        )
+        fused_step = _AOT_CACHE.get(k_fused)
+        if fused_step is None:
+            from ..ops.evolve import (
+                run_iteration_fused,
+                run_iteration_fused_donated,
+            )
+
+            base_fused = (
+                run_iteration_fused_donated if async_rb else run_iteration_fused
+            )
+            fused_step = base_fused.lower(
+                state, score_data, ecfg, score_fn, copt_impl, fin_sfn
+            ).compile()
+            _aot_cache_put(k_fused, fused_step)
+        run_step = copt_step = fin_step = None
+    elif options.jit_warmup:
         # AOT-compile (lower().compile()) bypasses the jit cache, so compiled
         # executables are memoized across equation_search calls — without
         # this every search pays the full ~40s engine compile even with
@@ -2002,6 +2197,11 @@ def device_search_one_output(
                 # bucket ladder are baked into the compiled const-opt
                 # program (while_loop bound, selection mechanism, switch)
                 options.optimizer_g_tol, _copt_env(), bucket_min(),
+                # which const-opt builder ran (pallas grad kernel vs scan
+                # interpreter) and the interpret gate are baked into the
+                # compiled program — and they change the ScoreData pytree
+                # structure the executable accepts
+                use_pallas_grad, _pallas_interpret(),
                 (pop_shards, rows_shards) if mesh else 0,
             )
             copt_step = _AOT_CACHE.get(k_copt)
@@ -2025,6 +2225,32 @@ def device_search_one_output(
                         state, score_data, ecfg, score_fn
                     ).compile()
                 _aot_cache_put(k_fin, fin_step)
+    else:
+        if iter_fn is not None:
+            run_step = iter_fn
+        elif fused_iter:
+            from ..ops.evolve import (
+                run_iteration_fused,
+                run_iteration_fused_donated,
+            )
+
+            _fused_jit = (
+                run_iteration_fused_donated if async_rb else run_iteration_fused
+            )
+            fused_step = lambda st, d: _fused_jit(  # noqa: E731
+                st, d, ecfg, score_fn, copt_impl, fin_sfn
+            )
+            run_step = None
+        else:
+            from ..ops.evolve import run_iteration_donated
+
+            _iter_jit = run_iteration_donated if async_rb else run_iteration
+            run_step = lambda st, d: _iter_jit(st, d, ecfg, score_fn)  # noqa: E731
+        copt_step = None if fused_step is not None else const_opt_fn
+        fin_step = None if fused_step is not None else finalize_fn
+        readback_step = readback_fn
+
+    if options.jit_warmup:
         k_rb = ("rb", ecfg)
         readback_step = _AOT_CACHE.get(k_rb)
         if readback_step is None:
@@ -2052,17 +2278,6 @@ def device_search_one_output(
             score_call(
                 Tree(*dummy_pool[:6], dummy_pool[6])
             ).block_until_ready()
-    else:
-        if iter_fn is not None:
-            run_step = iter_fn
-        else:
-            from ..ops.evolve import run_iteration_donated
-
-            _iter_jit = run_iteration_donated if async_rb else run_iteration
-            run_step = lambda st, d: _iter_jit(st, d, ecfg, score_fn)  # noqa: E731
-        copt_step = const_opt_fn
-        fin_step = finalize_fn
-        readback_step = readback_fn
 
     from ..utils.stdin_reader import StdinReader
 
@@ -2091,6 +2306,13 @@ def device_search_one_output(
     from ..utils.profiling import NULL_PROFILER, StageProfiler
 
     prof = StageProfiler() if options.profile else NULL_PROFILER
+    fused_fracs = None
+    if fused_step is not None and prof.enabled:
+        # profiling a fused search: derive the fused wall's decomposition
+        # once (probe fractions), reported as fused_iter/<leg> each iteration
+        fused_fracs = _probe_fused_fractions(
+            state, score_data, ecfg, score_fn, copt_impl, fin_sfn
+        )
     device_evals = 0.0
     # pipelined-loop carry: iteration i-1's packed readback (single-host) /
     # the double-buffered exchange slot (multi-host)
@@ -2229,31 +2451,47 @@ def device_search_one_output(
         # simulated preemption (fault-injection harness); counts one call
         # per iteration on every process that carries the spec
         injector.maybe_die("peer_death")
-        with prof.stage("evolve"):
-            state = run_step(state, score_data)
-            if replay is not None:
-                state, iter_log = state
-                replay.consume_iteration(iter_log)
-            prof.fence(state)
-        if copt_step is not None:
-            with prof.stage("const_opt"):
-                state = copt_step(state, score_data)
-                if replay is not None:
-                    state, tuning_log = state
-                    replay.consume_tuning(tuning_log)
+        if fused_step is not None:
+            # SR_FUSED_ITER: evolve → const-opt → finalize as ONE dispatch
+            t_f0 = time.perf_counter()
+            with prof.stage("fused_iter"):
+                _count_dispatch("fused_iter")
+                state = fused_step(state, score_data)
                 prof.fence(state)
-        if fin_step is not None:
-            # batching: full-data finalize AFTER the batch const-opt, so the
-            # readback below only ever sees exact losses
-            with prof.stage("finalize"):
-                state = fin_step(state, score_data)
+            if fused_fracs:
+                dt_f = time.perf_counter() - t_f0
+                for leg, frac in fused_fracs.items():
+                    prof.add_time(f"fused_iter/{leg}", dt_f * frac)
+        else:
+            with prof.stage("evolve"):
+                _count_dispatch("evolve")
+                state = run_step(state, score_data)
                 if replay is not None:
-                    state, fin_log = state
-                    for mk in ("mig_island", "mig_hof"):
-                        if mk in fin_log:
-                            replay.consume_migration(fin_log[mk])
+                    state, iter_log = state
+                    replay.consume_iteration(iter_log)
                 prof.fence(state)
+            if copt_step is not None:
+                with prof.stage("const_opt"):
+                    _count_dispatch("const_opt")
+                    state = copt_step(state, score_data)
+                    if replay is not None:
+                        state, tuning_log = state
+                        replay.consume_tuning(tuning_log)
+                    prof.fence(state)
+            if fin_step is not None:
+                # batching: full-data finalize AFTER the batch const-opt, so
+                # the readback below only ever sees exact losses
+                with prof.stage("finalize"):
+                    _count_dispatch("finalize")
+                    state = fin_step(state, score_data)
+                    if replay is not None:
+                        state, fin_log = state
+                        for mk in ("mig_island", "mig_hof"):
+                            if mk in fin_log:
+                                replay.consume_migration(fin_log[mk])
+                    prof.fence(state)
         with prof.stage("readback_pack"):
+            _count_dispatch("readback")
             rb = readback_step(state)  # the iteration's ONE readback
             prof.fence(rb)
         pool_dev = ()
@@ -2262,6 +2500,7 @@ def device_search_one_output(
             # the readback buffer; skipped when migration is off (options
             # are identical on every process, so the exchange stays uniform)
             with prof.stage("pool_extract"):
+                _count_dispatch("pool_extract")
                 pool_dev = extract_topn_pool(state, ecfg)
                 prof.fence(pool_dev)
 

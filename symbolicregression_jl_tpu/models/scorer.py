@@ -46,8 +46,12 @@ class BatchScorer:
         if options.data_sharding == "rows":
             self._setup_row_sharding()
         # Fused Mosaic loss kernel: probe once per (operator set, loss); falls
-        # back to the scan interpreter off-TPU, for non-lowerable operators,
-        # or for non-float32 compute dtypes (the kernel is f32-only).
+        # back to the scan interpreter off-TPU (unless SR_PALLAS_INTERPRET=1
+        # emulates the kernels via the Pallas interpreter — parity testing
+        # only, orders of magnitude slower), for non-lowerable operators, or
+        # for non-float32 compute dtypes (the kernel is f32-only). The hot
+        # loop below holds this closure rather than calling the one-shot
+        # loss_trees_pallas packing helpers (sr-lint SRL008).
         self._pallas_loss = None
         if self._sharded is None and np.dtype(self.dtype) == np.float32:
             from ..ops.interp_pallas import make_pallas_loss_fn, pallas_supported

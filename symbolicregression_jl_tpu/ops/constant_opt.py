@@ -8,6 +8,14 @@ selected set — every (member, restart) pair across all islands — is one
 vmapped XLA program: gradients come from ``jax.grad`` through the batched
 interpreter's custom VJP, the line search is a ``lax.while_loop`` backtracking
 search, and non-constant slots are masked out of the update.
+
+This module is the interpreter (scan) gradient path. The device engine's
+const-opt additionally has a Pallas gradient path: when the fused Mosaic loss
+kernel is supported, ``interp_pallas.pallas_diff_loss`` (a ``jax.custom_vjp``
+around the fused loss+grad kernel) replaces the interpreter VJP inside the
+BFGS while_loops, so each value+gradient evaluation is ONE kernel launch
+(see device_search._make_const_opt_fn_pallas). Both paths share the masking,
+line-search, and accept-only-if-improved semantics here.
 """
 
 from __future__ import annotations

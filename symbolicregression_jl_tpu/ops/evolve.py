@@ -1515,6 +1515,51 @@ run_finalize = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
 )
 
 
+def _run_iteration_fused_impl(
+    state: EvoState, data, cfg: EvoConfig, score_fn, copt_impl=None,
+    fin_score_fn=None, axis=None,
+) -> EvoState:
+    """One engine iteration as a SINGLE program: evolve → (length-compacted)
+    constant optimization → full-data finalize, chained inside one trace so
+    XLA sees the whole iteration — the dispatch chain the engine used to issue
+    (run_step + per-bucket copt_step + fin_step) collapses to one executable
+    and the readback is the only other per-iteration dispatch (SR_FUSED_ITER,
+    ≤2 dispatches/iteration).
+
+    ``copt_impl``: the UNJITTED closure from a ``_make_const_opt_fn*`` builder
+    (``(state, data) -> state``), or None. ``fin_score_fn``: full-data score_fn
+    for the finalize leg, used only under ``cfg.batching`` (mirrors the
+    unfused driver, which only builds fin_step when batching). The chained
+    computations are the SAME traced functions the split path jits
+    individually, so fused results are bit-identical to the split dispatch
+    chain (pinned by tests/test_fused_iter.py)."""
+    if cfg.record_events:
+        raise ValueError(
+            "fused iteration does not support record_events (replay drivers "
+            "read per-program logs; use the split dispatch chain)"
+        )
+    state = _run_iteration_impl(state, data, cfg, score_fn, axis=axis)
+    if copt_impl is not None:
+        state = copt_impl(state, data)
+    if cfg.batching and fin_score_fn is not None:
+        state = _finalize_impl(state, data, cfg, fin_score_fn, axis=axis)
+    return state
+
+
+run_iteration_fused = functools.partial(
+    jax.jit, static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn")
+)(_run_iteration_fused_impl)
+
+# donated twin (see run_iteration_donated): the fused program consumes and
+# re-emits the full EvoState, so the engine threads one set of state buffers
+# through every iteration with zero copies
+run_iteration_fused_donated = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn"),
+    donate_argnums=(0,),
+)(_run_iteration_fused_impl)
+
+
 def make_sharded_finalize(mesh, cfg_local: EvoConfig, score_fn, data_specs=None):
     """shard_map twin of make_sharded_iteration for the finalize program."""
     specs = evo_state_specs()

@@ -27,6 +27,7 @@ interpreter when unsupported.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -38,13 +39,31 @@ from jax.experimental.pallas import tpu as pltpu
 from .flat import KIND_BINARY, KIND_CONST, KIND_UNARY, KIND_VAR, FlatTrees
 from .operators import OperatorSet
 
+# jax 0.4.x ships this as TPUCompilerParams; newer releases renamed it
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 __all__ = [
     "eval_trees_pallas",
     "loss_trees_pallas",
     "make_pallas_loss_fn",
     "make_packed_loss_fn",
+    "make_pallas_diff_loss_fn",
+    "pallas_diff_loss",
+    "pallas_interpret_enabled",
     "pallas_supported",
 ]
+
+
+def pallas_interpret_enabled() -> bool:
+    """SR_PALLAS_INTERPRET=1 runs every pallas_call with ``interpret=True`` so
+    the kernels execute (emulated) on CPU — the parity-test path for hosts
+    without a TPU. Host-side read only: callers consult this at BUILD time and
+    thread the answer through as a static argname (the env var participates in
+    the jit cache keys that way; reading it inside traced code would violate
+    SRL004)."""
+    return os.environ.get("SR_PALLAS_INTERPRET", "0") == "1"
 
 
 def _round_up(n: int, m: int) -> int:
@@ -123,14 +142,16 @@ def _make_kernel(opset: OperatorSet, n_slots: int, p_tile: int, r_tile: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("opset", "n_slots", "p_tile", "r_tile")
+    jax.jit, static_argnames=("opset", "n_slots", "p_tile", "r_tile", "interpret")
 )
-def _eval_pallas(ints, vals, X, opset, n_slots, p_tile, r_tile):
+def _eval_pallas(ints, vals, X, opset, n_slots, p_tile, r_tile, interpret=False):
     P, L = ints.shape
     Lv = vals.shape[1]
     F, R_padded = X.shape
     n_r_tiles = R_padded // r_tile
     kernel = _make_kernel(opset, n_slots, p_tile, r_tile)
+    if interpret:
+        kernel.__name__ += "_interp"
 
     return pl.pallas_call(
         kernel,
@@ -150,9 +171,10 @@ def _eval_pallas(ints, vals, X, opset, n_slots, p_tile, r_tile):
             pltpu.VMEM((n_slots, r_tile), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel"),
         ),
+        interpret=interpret,
     )(ints, vals, X)
 
 
@@ -200,7 +222,10 @@ def eval_trees_pallas(
     if P % p_tile != 0:
         raise ValueError(f"P={P} must be a multiple of p_tile={p_tile}")
     ints, vals = pack_flat(flat)
-    preds = _eval_pallas(ints, vals, X, opset, N, p_tile, r_tile)
+    preds = _eval_pallas(
+        ints, vals, X, opset, N, p_tile, r_tile,
+        interpret=pallas_interpret_enabled(),
+    )
     return preds[:, :R]
 
 
@@ -311,7 +336,7 @@ def _make_loss_kernel(
 
                 return 0
 
-            lax.fori_loop(0, length, slot_body, 0, unroll=False)
+            lax.fori_loop(0, length, slot_body, 0)
 
             root8 = pl.multiple_of((length - 1) * 8, 8)
             pred = buf_ref[pl.ds(root8, 8), :]  # (8, c_tile)
@@ -360,9 +385,14 @@ def _name_with_P(kernel, P: int):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("opset", "loss_elem", "n_slots", "p_tile", "c_tile", "C", "R"),
+    static_argnames=(
+        "opset", "loss_elem", "n_slots", "p_tile", "c_tile", "C", "R", "interpret"
+    ),
 )
-def _loss_pallas(ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile, C, R):
+def _loss_pallas(
+    ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile, C, R,
+    interpret=False,
+):
     P = ints.shape[0]
     F = Xr.shape[0] // 8  # Xr is (F*8, C): feature f occupies sublane rows 8f..8f+8
     n_c_tiles = C // c_tile
@@ -371,6 +401,8 @@ def _loss_pallas(ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_ti
     kernel = _name_with_P(
         _make_loss_kernel(opset, loss_elem, n_slots, p_tile, c_tile, C, R), P
     )
+    if interpret:
+        kernel.__name__ += "_interp"
 
     out = pl.pallas_call(
         kernel,
@@ -394,9 +426,10 @@ def _loss_pallas(ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_ti
             pltpu.VMEM((n_slots * 8, c_tile), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
+        interpret=interpret,
     )(ints, vals, Xr, yr, wr)
 
     loss_sum, w_sum, nonfin = out[:, 0], out[:, 1], out[:, 2]
@@ -500,6 +533,7 @@ def make_pallas_loss_fn(X, y, weights, opset: OperatorSet, loss_elem):
     loss_elem(pred, y) over real rows, inf where any pred is non-finite
     (/root/reference/src/LossFunctions.jl:45-75)."""
     Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+    interpret = pallas_interpret_enabled()
 
     def fn(flat: FlatTrees) -> jax.Array:
         P, N = flat.kind.shape
@@ -507,7 +541,8 @@ def make_pallas_loss_fn(X, y, weights, opset: OperatorSet, loss_elem):
             raise ValueError(f"P={P} must be a multiple of {P_TILE_LOSS}")
         ints, vals = pack_flat_fused(flat, opset)
         return _loss_pallas(
-            ints, vals, Xr, yr, wr, opset, loss_elem, N, P_TILE_LOSS, C_TILE, C, R
+            ints, vals, Xr, yr, wr, opset, loss_elem, N, P_TILE_LOSS, C_TILE, C, R,
+            interpret=interpret,
         )
 
     return fn
@@ -522,9 +557,14 @@ def loss_trees_pallas(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("opset", "loss_elem", "n_slots", "has_weights", "R")
+    jax.jit,
+    static_argnames=(
+        "opset", "loss_elem", "n_slots", "has_weights", "R", "interpret"
+    ),
 )
-def _loss_pallas_dyn(ints, vals, X, y, w, opset, loss_elem, n_slots, has_weights, R):
+def _loss_pallas_dyn(
+    ints, vals, X, y, w, opset, loss_elem, n_slots, has_weights, R, interpret=False
+):
     """Fused loss with per-call dataset (minibatch path): the sublane pad +
     reshape happens IN-GRAPH on device, so callers can pass fresh row subsets
     without host-side repacking. One compile per (batch length R, statics)."""
@@ -548,6 +588,7 @@ def _loss_pallas_dyn(ints, vals, X, y, w, opset, loss_elem, n_slots, has_weights
         C_TILE,
         C,
         R,
+        interpret=interpret,
     )
 
 
@@ -568,6 +609,7 @@ def loss_trees_pallas_batch(flat: FlatTrees, X, y, weights, opset, loss_elem):
         flat.kind.shape[1],
         has_w,
         int(X.shape[-1]),
+        interpret=pallas_interpret_enabled(),
     )
 
 
@@ -576,6 +618,7 @@ def make_packed_loss_fn(X, y, weights, opset: OperatorSet, loss_elem, n_slots: i
     (ops.flat.FlatSlab layout) — zero per-call host packing. Returns
     ``fn(ints [P, L] int32, vals [P, Lv] f32) -> losses [P]``."""
     Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+    interpret = pallas_interpret_enabled()
 
     def fn(ints, vals) -> jax.Array:
         P = ints.shape[0]
@@ -594,6 +637,7 @@ def make_packed_loss_fn(X, y, weights, opset: OperatorSet, loss_elem, n_slots: i
             C_TILE,
             C,
             R,
+            interpret=interpret,
         )
 
     return fn
@@ -606,13 +650,14 @@ def pallas_supported(opset: OperatorSet, n_features: int = 2, loss_elem=None) ->
     """Probe whether the fused loss kernel lowers through Mosaic for this
     (operator set, loss) — by COMPILING it, not by platform-string matching
     (the TPU registers under the experimental 'axon' plugin on some hosts).
-    Cached per (opset, loss)."""
+    Cached per (opset, loss, interpret)."""
     from .losses import L2DistLoss
 
     loss_elem = loss_elem or L2DistLoss
-    if jax.devices()[0].platform == "cpu":
+    interpret = pallas_interpret_enabled()
+    if jax.devices()[0].platform == "cpu" and not interpret:
         return False  # Mosaic needs a TPU; the scan interpreter is the CPU path
-    key = (opset, loss_elem)
+    key = (opset, loss_elem, interpret)
     if key in _SUPPORT_CACHE:
         return _SUPPORT_CACHE[key]
     try:
@@ -739,7 +784,7 @@ def _make_loss_grad_kernel(
 
                 return 0
 
-            lax.fori_loop(0, length, slot_body, 0, unroll=False)
+            lax.fori_loop(0, length, slot_body, 0)
 
             root8 = pl.multiple_of((length - 1) * 8, 8)
             pred = buf_ref[pl.ds(root8, 8), :]
@@ -803,7 +848,7 @@ def _make_loss_grad_kernel(
 
                 return 0
 
-            lax.fori_loop(0, length, rev_body, 0, unroll=False)
+            lax.fori_loop(0, length, rev_body, 0)
             return 0
 
         lax.fori_loop(0, p_tile, tree_body, 0)
@@ -817,10 +862,13 @@ def _make_loss_grad_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("opset", "loss_elem", "n_slots", "p_tile", "c_tile", "C", "R"),
+    static_argnames=(
+        "opset", "loss_elem", "n_slots", "p_tile", "c_tile", "C", "R", "interpret"
+    ),
 )
 def _loss_grad_pallas(
-    ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile, C, R
+    ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile, C, R,
+    interpret=False,
 ):
     """Returns (losses [P], grads [P, n_slots]): weighted-mean loss and its
     gradient w.r.t. every val slot (nonzero only on constant slots)."""
@@ -832,6 +880,8 @@ def _loss_grad_pallas(
     kernel = _name_with_P(
         _make_loss_grad_kernel(opset, loss_elem, n_slots, p_tile, c_tile, C, R), P
     )
+    if interpret:
+        kernel.__name__ += "_interp"
 
     out, grad = pl.pallas_call(
         kernel,
@@ -864,9 +914,10 @@ def _loss_grad_pallas(
             pltpu.VMEM((n_slots * 8, c_tile), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
+        interpret=interpret,
     )(ints, vals, Xr, yr, wr)
 
     loss_sum, w_sum, nonfin = out[:, 0], out[:, 1], out[:, 2]
@@ -883,6 +934,7 @@ def make_pallas_loss_grad_fn(X, y, weights, opset: OperatorSet, loss_elem):
     Gradient convention matches jax.grad through the scan interpreter's loss
     (weighted normalized mean, inf/zero-grad on non-finite predictions)."""
     Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+    interpret = pallas_interpret_enabled()
 
     def fn(ints, vals, n_slots: int):
         B = ints.shape[0]
@@ -892,7 +944,7 @@ def make_pallas_loss_grad_fn(X, y, weights, opset: OperatorSet, loss_elem):
         vpad = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, Lv - n_slots)))
         return _loss_grad_pallas(
             ints, vpad, Xr, yr, wr, opset, loss_elem, n_slots,
-            P_TILE_LOSS, C_TILE, C, R,
+            P_TILE_LOSS, C_TILE, C, R, interpret=interpret,
         )
 
     return fn
@@ -902,13 +954,14 @@ def pallas_grad_supported(
     opset: OperatorSet, n_features: int = 2, loss_elem=None
 ) -> bool:
     """Probe-compile the loss+grad kernel (per-operator jax.vjp lambdas must
-    also lower through Mosaic). Cached per (opset, loss)."""
+    also lower through Mosaic). Cached per (opset, loss, interpret)."""
     from .losses import L2DistLoss
 
     loss_elem = loss_elem or L2DistLoss
-    if jax.devices()[0].platform == "cpu":
+    interpret = pallas_interpret_enabled()
+    if jax.devices()[0].platform == "cpu" and not interpret:
         return False
-    key = ("grad", opset, loss_elem)
+    key = ("grad", opset, loss_elem, interpret)
     if key in _SUPPORT_CACHE:
         return _SUPPORT_CACHE[key]
     try:
@@ -938,3 +991,89 @@ def pallas_grad_supported(
         )
         _SUPPORT_CACHE[key] = False
     return _SUPPORT_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper: a DIFFERENTIABLE batch loss whose backward pass is the
+# fused loss+grad kernel. jax.grad / jax.value_and_grad through this function
+# consume in-kernel gradients — the scan interpreter's SSA buffer is never
+# re-materialized through HBM, and value_and_grad costs ONE kernel launch
+# (the forward residual already holds the gradient).
+#
+# The dataset rows (Xr, yr, wr) are explicit primals, not closure state, so
+# the wrapper can be applied to TRACED data inside a jitted const-opt program
+# (custom_vjp functions must not close over tracers); their cotangents are
+# declared zero — constants live in `vals`, nothing differentiates the data.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _diff_loss_cached(opset, loss_elem, n_slots, p_tile, c_tile, C, R, interpret):
+    Lv = _round_up(n_slots, 128)
+
+    @jax.custom_vjp
+    def loss(ints, vals, Xr, yr, wr):
+        return _loss_pallas(
+            ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile,
+            C, R, interpret=interpret,
+        )
+
+    def _fwd(ints, vals, Xr, yr, wr):
+        losses, grads = _loss_grad_pallas(
+            ints, vals, Xr, yr, wr, opset, loss_elem, n_slots, p_tile, c_tile,
+            C, R, interpret=interpret,
+        )
+        return losses, (ints, grads, Xr, yr, wr)
+
+    def _bwd(res, ct):
+        ints, grads, Xr, yr, wr = res
+        # per-instance losses are independent, so the vals cotangent is just
+        # the per-row cotangent broadcast over that row's in-kernel gradient
+        gv = jnp.pad(ct[:, None] * grads, ((0, 0), (0, Lv - n_slots)))
+        return (
+            np.zeros(ints.shape, jax.dtypes.float0),  # int primal: float0 ct
+            gv,
+            jnp.zeros_like(Xr),
+            jnp.zeros_like(yr),
+            jnp.zeros_like(wr),
+        )
+
+    loss.defvjp(_fwd, _bwd)
+    return loss
+
+
+def pallas_diff_loss(
+    ints, vals, Xr, yr, wr, opset, loss_elem, n_slots,
+    p_tile=P_TILE_LOSS, c_tile=C_TILE, *, C, R, interpret=False,
+):
+    """Differentiable fused loss: ``losses [P]`` = weighted-mean loss per
+    instance, with d(loss)/d(vals) supplied by the Pallas loss+grad kernel via
+    custom_vjp. ``vals`` must be padded to roundup(n_slots, 128) lanes (the
+    cotangent comes back in that shape). Safe to call on traced data inside a
+    jitted program."""
+    fn = _diff_loss_cached(
+        opset, loss_elem, n_slots, p_tile, c_tile, C, R, interpret
+    )
+    return fn(ints, vals, Xr, yr, wr)
+
+
+def make_pallas_diff_loss_fn(X, y, weights, opset: OperatorSet, loss_elem):
+    """Host-side convenience over pallas_diff_loss: dataset resident in
+    sublane layout, returns ``fn(ints [B, L], vals [B, N], n_slots) ->
+    losses [B]`` differentiable w.r.t. vals (jax.grad/value_and_grad hit the
+    loss+grad kernel, never the scan interpreter)."""
+    Xr, yr, wr, C, R = _reshape_rows(X, y, weights)
+    interpret = pallas_interpret_enabled()
+
+    def fn(ints, vals, n_slots: int):
+        B = ints.shape[0]
+        if B % P_TILE_LOSS != 0:
+            raise ValueError(f"B={B} must be a multiple of {P_TILE_LOSS}")
+        Lv = _round_up(n_slots, 128)
+        vpad = jnp.pad(vals.astype(jnp.float32), ((0, 0), (0, Lv - n_slots)))
+        return pallas_diff_loss(
+            ints, vpad, Xr, yr, wr, opset, loss_elem, n_slots,
+            C=C, R=R, interpret=interpret,
+        )
+
+    return fn

@@ -85,7 +85,10 @@ def batched_loss_jit(flat, X, y, weights, opset, loss_elem, use_pallas=False) ->
     call (np.asarray on X — a device-to-host copy if X is device-resident,
     which permanently degrades this backend's dispatch to sync mode). It is
     for one-shot use only; hot loops MUST hold a make_pallas_loss_fn /
-    make_packed_loss_fn closure instead."""
+    make_packed_loss_fn closure instead. This contract is ENFORCED by
+    sr-lint rule SRL008 (analysis/lint.py): calling this with
+    ``use_pallas=True`` — or ``loss_trees_pallas*`` — inside an
+    engine-driver loop fails the lint gate."""
     if use_pallas:
         return batched_loss(flat, X, y, weights, opset, loss_elem, True)
     has_weights = weights is not None

@@ -235,6 +235,20 @@ class Options:
     # profile is set; False = always synchronous; True = explicit request
     # (rejected with use_recorder, which needs lockstep replay).
     async_readback: bool | None = None
+    # Three env gates (not Options fields: they select compiled-program
+    # variants, so they are baked into the score-fn/AOT cache keys rather
+    # than threaded through the dataclass):
+    #   SR_ENGINE_PALLAS (default 1) — score in-evolve candidates with the
+    #     fused Pallas loss kernel, bucket-sized via the length ladder;
+    #     0 restores interpreter scoring inside the engine.
+    #   SR_FUSED_ITER (default 1) — fuse evolve → const-opt → finalize into
+    #     ONE jitted megaprogram per iteration (≤2 dispatches with the
+    #     readback); 0 restores the split three-program loop (bit-identical).
+    #     Auto-falls back to split under a mesh, the recorder, or
+    #     record_events.
+    #   SR_PALLAS_INTERPRET (default 0) — run every Pallas kernel through
+    #     the Pallas interpreter so the whole Pallas engine path executes
+    #     (slowly) on CPU; parity testing only.
 
     # -- fault tolerance ------------------------------------------------------
     # full-state checkpoint cadence: every N iterations and/or every S

@@ -111,6 +111,21 @@ class StageProfiler:
             jax.block_until_ready(x)
         return x
 
+    def add_time(self, name: str, seconds: float):
+        """Accumulate an externally measured duration into the current
+        iteration's record — for stages the caller cannot bracket with
+        ``stage()`` (e.g. probe-estimated sub-timings of a single fused XLA
+        program). Sub-stage names containing ``/`` (``"fused_iter/const_opt"``)
+        are reported by ``summary()`` but EXCLUDED from the attributed sum, so
+        a derived decomposition of a parent stage never double-counts against
+        ``other``."""
+        if not self.enabled:
+            return
+        if self._iter_t0 is None:
+            self._iter_t0 = time.perf_counter()
+        cur = self._current
+        cur[name] = cur.get(name, 0.0) + seconds
+
     def next_iteration(self):
         """Close the current iteration's record and push it to the ring."""
         if not self.enabled:
@@ -151,7 +166,8 @@ class StageProfiler:
             vals = [r.get(name, 0.0) for r in iters]
             sv = sorted(vals)
             mean = sum(vals) / n
-            attributed += mean
+            if "/" not in name:  # sub-stages decompose a parent, not the wall
+                attributed += mean
             stages[name] = {
                 "mean_ms": mean * 1e3,
                 "p50_ms": self._pct(sv, 0.50) * 1e3,

@@ -1,0 +1,26 @@
+"""SRL008 clean twin: the packed-closure contract, and one-shot calls where
+they are allowed (outside loops / outside hot-path functions)."""
+from symbolicregression_jl_tpu.ops.interp_pallas import (
+    loss_trees_pallas,
+    make_pallas_loss_fn,
+)
+from symbolicregression_jl_tpu.ops.scoring import batched_loss_jit
+
+
+def device_search_one_output(ints, vals, X, y, opset, loss, niterations):
+    # hot loops hold the packed closure: dataset packed ONCE at build time
+    loss_fn = make_pallas_loss_fn(X, y, None, opset, loss)
+    total = 0.0
+    for it in range(niterations):
+        total += float(loss_fn(ints, vals)[0])
+    # one-shot call after the loop: allowed (deliberate, not per-iteration)
+    total += float(loss_trees_pallas([], X, y, None, opset, loss).sum())
+    return total
+
+
+def cold_helper(trees, X, y):
+    # not a hot-path function: the conveniences are fine even in loops
+    out = []
+    for _ in range(2):
+        out.append(batched_loss_jit(trees, X, y, use_pallas=True))
+    return out

@@ -1,0 +1,18 @@
+"""SRL008 violation: one-shot Pallas host packing inside an engine hot loop.
+
+``loss_trees_pallas`` / ``batched_loss_jit(use_pallas=True)`` re-pack the
+batch on the host every call (ops/scoring.py contract: one-shot only; hot
+loops must hold a ``make_pallas_loss_fn`` closure).
+"""
+from symbolicregression_jl_tpu.ops.interp_pallas import loss_trees_pallas
+from symbolicregression_jl_tpu.ops.scoring import batched_loss_jit
+
+
+def device_search_one_output(trees, X, y, opset, loss, niterations):
+    total = 0.0
+    for it in range(niterations):
+        losses = loss_trees_pallas(trees, X, y, None, opset, loss)  # EXPECT: SRL008
+        total += float(losses[0])
+        again = batched_loss_jit(trees, X, y, use_pallas=True)  # EXPECT: SRL008
+        total += float(again[0])
+    return total

@@ -1,0 +1,112 @@
+"""SR_FUSED_ITER megaprogram (round 10): bit-identity with the split
+three-program loop, the <=2-device-dispatches-per-iteration invariant
+(counted through device_search._DISPATCH_HOOK), and the score-fn cache's
+LRU policy."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.models import device_search as ds
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _frontier(res):
+    return [(m.complexity, m.loss) for m in res.pareto_frontier]
+
+
+@pytest.mark.parametrize("batching", [False, True])
+def test_fused_matches_split_bit_identical(batching, monkeypatch):
+    """The fused evolve->const_opt->finalize megaprogram must be a pure
+    dispatch-count optimization: same seed, bit-identical frontier vs the
+    split loop (SR_ENGINE_PALLAS=0 pins both runs to interpreter scoring)."""
+    monkeypatch.setenv("SR_ENGINE_PALLAS", "0")
+    X, y = _problem()
+    kw = dict(batching=True, batch_size=64) if batching else {}
+    monkeypatch.setenv("SR_FUSED_ITER", "0")
+    r_split = equation_search(
+        X, y, options=_opts(**kw), niterations=3, verbosity=0
+    )
+    monkeypatch.setenv("SR_FUSED_ITER", "1")
+    r_fused = equation_search(
+        X, y, options=_opts(**kw), niterations=3, verbosity=0
+    )
+    assert _frontier(r_fused) == _frontier(r_split)
+    assert r_fused.best().tree.same_structure(r_split.best().tree)
+
+
+def test_fused_dispatch_count_per_iteration(monkeypatch):
+    """<=2 device dispatches per iteration under SR_FUSED_ITER=1: the
+    megaprogram plus the packed readback — nothing else."""
+    monkeypatch.setenv("SR_FUSED_ITER", "1")
+    calls = []
+    monkeypatch.setattr(ds, "_DISPATCH_HOOK", calls.append)
+    X, y = _problem()
+    equation_search(X, y, options=_opts(), niterations=3, verbosity=0)
+    counts = {name: calls.count(name) for name in set(calls)}
+    assert set(counts) == {"fused_iter", "readback"}, counts
+    assert counts["fused_iter"] == 3
+    assert counts["readback"] == 3
+
+
+def test_split_path_still_counts_stages(monkeypatch):
+    """SR_FUSED_ITER=0 recovers the split loop: per-iteration evolve and
+    const_opt dispatches, no megaprogram."""
+    monkeypatch.setenv("SR_FUSED_ITER", "0")
+    calls = []
+    monkeypatch.setattr(ds, "_DISPATCH_HOOK", calls.append)
+    X, y = _problem()
+    equation_search(X, y, options=_opts(), niterations=2, verbosity=0)
+    assert calls.count("evolve") == 2
+    assert calls.count("const_opt") == 2
+    assert "fused_iter" not in calls
+
+
+def test_cache_get_lru():
+    """_cache_get_lru refreshes hits to the MRU slot, so the insert-side
+    eviction (pop the FIRST key) removes the least-recently-USED entry,
+    not the oldest insert."""
+    cache = {"a": 1, "b": 2, "c": 3}
+    assert ds._cache_get_lru(cache, "a") == 1
+    assert list(cache) == ["b", "c", "a"]  # hit moved to the back
+    assert ds._cache_get_lru(cache, "zz") is None  # miss: order untouched
+    assert list(cache) == ["b", "c", "a"]
+    cache.pop(next(iter(cache)))  # the insert-side eviction step
+    assert "a" in cache and "b" not in cache
+
+
+def test_score_fn_cache_evicts_least_recently_used(monkeypatch):
+    """At the 12-entry cap, touching the oldest-inserted entry through the
+    production lookup keeps it alive past the next eviction."""
+    fake = {f"k{i}": i for i in range(12)}
+    monkeypatch.setattr(ds, "_SCORE_FN_CACHE", fake)
+    with ds._CACHE_LOCK:
+        assert ds._cache_get_lru(ds._SCORE_FN_CACHE, "k0") == 0
+    # mirror of the insert path in _make_score_fn: evict-first, then insert
+    if len(ds._SCORE_FN_CACHE) >= 12:
+        ds._SCORE_FN_CACHE.pop(next(iter(ds._SCORE_FN_CACHE)))
+    ds._SCORE_FN_CACHE["new"] = object()
+    assert "k0" in ds._SCORE_FN_CACHE
+    assert "k1" not in ds._SCORE_FN_CACHE

@@ -20,7 +20,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
 LINT_PY = os.path.join(REPO, "symbolicregression_jl_tpu", "analysis", "lint.py")
 
-RULE_IDS = ["SRL001", "SRL002", "SRL003", "SRL004", "SRL005", "SRL006", "SRL007"]
+RULE_IDS = [
+    "SRL001", "SRL002", "SRL003", "SRL004", "SRL005", "SRL006", "SRL007",
+    "SRL008",
+]
 
 
 def _load_lint():
