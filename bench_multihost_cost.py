@@ -145,6 +145,7 @@ overlap_ms = 1e3 * t_overlap[reps // 2]
 if pid == 0:
     print(json.dumps({{
         "metric": "multihost_exchange_cost",
+        "topology": "flat",
         "processes": nproc,
         "islands_per_process": I_local,
         "topn": topn,
@@ -168,11 +169,95 @@ if pid == 0:
 """
 
 
-def run_one(nproc: int) -> dict:
+_RING_WORKER = """
+import os, sys, time, json
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+from symbolicregression_jl_tpu.parallel.distributed import initialize
+initialize(coordinator_address="localhost:{port}", num_processes=nproc, process_id=pid)
+
+import numpy as np
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.parallel import membership
+
+options = Options(
+    binary_operators=["+", "-", "*", "/"], unary_operators=["cos", "exp", "abs"],
+    populations=40, population_size=33, maxsize=20, save_to_file=False,
+)
+I_local = max(1, options.populations // nproc)
+N = options.max_nodes
+S1 = options.maxsize + 1
+topn = min(options.topn, options.population_size)
+rows = I_local * topn
+
+buf = np.zeros((S1 * 3 + S1 * N * 6 + 2,), np.float32)
+pool = (
+    *(np.zeros((rows, N), np.int32) for _ in range(5)),
+    np.zeros((rows, N), np.float32),
+    np.zeros((rows,), np.int32),
+    np.zeros((rows,), np.float32),
+)
+payload_in = buf.nbytes + sum(a.nbytes for a in pool)
+
+# the r11 hierarchical exchange: each process posts once and reads ONLY its
+# ring predecessor, so payload_out is 2x payload_in at ANY process count —
+# the per-step exchange stops scaling O(N)
+grp = membership.ExchangeGroup(
+    membership.JaxCoordStore(), "bench-ring", pid, nproc,
+    on_peer_loss="raise", topology="ring", start_heartbeat=False,
+)
+it = 0
+for _ in range(3):  # warm the collective path (+ key reclamation cadence)
+    grp.exchange((buf, *pool))
+    it += 1
+    grp.stop_sync(0, 0.0, it)
+
+ex_times, ss_times = [], []
+for _ in range(20):
+    t0 = time.perf_counter()
+    grp.exchange((buf, *pool))
+    ex_times.append(time.perf_counter() - t0)
+    t1 = time.perf_counter()
+    it += 1
+    grp.stop_sync(0, 0.0, it)
+    ss_times.append(time.perf_counter() - t1)
+grp.close()
+ex_times.sort(); ss_times.sort()
+
+if pid == 0:
+    print(json.dumps({{
+        "metric": "multihost_exchange_cost",
+        "topology": "ring",
+        "processes": nproc,
+        "islands_per_process": I_local,
+        "topn": topn,
+        "n_slots": N,
+        "maxsize": options.maxsize,
+        "payload_bytes_in": int(payload_in),
+        "payload_bytes_out": int(payload_in * 2),
+        "gather_ms_median": round(1e3 * ex_times[len(ex_times) // 2], 2),
+        "gather_ms_p90": round(1e3 * ex_times[int(len(ex_times) * 0.9)], 2),
+        "stop_sync_ms_median": round(1e3 * ss_times[len(ss_times) // 2], 2),
+        "transport": "kv-loopback (virtual mesh; payload exact, time indicative)",
+        "timing": "loop_only (init + 3 warmup exchange/stop_sync rounds excluded)",
+        "interpretation": (
+            "ring: one post + one predecessor read per step, so payload_out "
+            "is 2x payload_in at any N; stop_sync carries 2 float64s and is "
+            "the only O(N) step left"
+        ),
+    }}), flush=True)
+"""
+
+
+def run_one(nproc: int, topology: str = "flat") -> dict:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
-    code = _WORKER.format(repo=REPO, port=port)
+    template = _RING_WORKER if topology == "ring" else _WORKER
+    code = template.format(repo=REPO, port=port)
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     # one device per worker process (see tests/test_multihost.py:_run_pair)
     env["XLA_FLAGS"] = " ".join(
@@ -200,12 +285,19 @@ def main():
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="write all rows as a JSON array")
+    ap.add_argument(
+        "--topology", choices=("flat", "ring", "both"), default="flat",
+        help="flat = r06 all-to-all allgather; ring = r11 hierarchical "
+        "exchange (post once, read the ring predecessor only)",
+    )
     args = ap.parse_args()
+    topologies = ("flat", "ring") if args.topology == "both" else (args.topology,)
     rows = []
-    for nproc in (2, 4, 8):
-        r = run_one(nproc)
-        print(json.dumps(r), flush=True)
-        rows.append(r)
+    for topology in topologies:
+        for nproc in (2, 4, 8):
+            r = run_one(nproc, topology=topology)
+            print(json.dumps(r), flush=True)
+            rows.append(r)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(rows, f, indent=2)

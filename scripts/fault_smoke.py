@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Fault-injection CI smoke (tiny config, CPU backend).
 
-Two end-to-end cycles through the fault-tolerant runtime, minutes not hours:
+Three end-to-end cycles through the fault-tolerant runtime, minutes not hours:
 
 1. **Checkpoint/resume**: a serial search is preempted (injected
    ``peer_death``) at iteration 2 of 4 with a snapshot after every
@@ -12,9 +12,16 @@ Two end-to-end cycles through the fault-tolerant runtime, minutes not hours:
    allgather on both sides partitions them. Under
    ``on_peer_loss="continue"`` each side must record the other dead and
    COMPLETE its search solo instead of raising.
+3. **Elastic rejoin**: a 2-process search over the FileCoordStore elastic
+   runtime (``SR_COORD_DIR``, no jax.distributed); one worker is killed
+   mid-run by an injected ``peer_death``, restarted with
+   ``SR_ELASTIC_JOIN=1``, and must rejoin at a later membership epoch,
+   adopt the leader's checkpoint shard, and finish — with the survivor's
+   final frontier matching a no-fault elastic run within tolerance.
 
 Exits nonzero on the first violated invariant. Usage: python
-scripts/fault_smoke.py (CI passes no args; JAX_PLATFORMS=cpu is forced).
+scripts/fault_smoke.py [checkpoint|exchange|elastic] (CI passes no args =
+all; JAX_PLATFORMS=cpu is forced).
 """
 
 from __future__ import annotations
@@ -165,7 +172,179 @@ def smoke_degraded_exchange() -> None:
     print("OK degraded exchange: both partitions completed solo")
 
 
+_ELASTIC_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+pid = int(os.environ["SR_ELASTIC_ID"])
+
+import numpy as np
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.parallel import distributed as dist
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2, 96)).astype(np.float32)
+y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+options = Options(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    populations=4, population_size=16,
+    ncycles_per_iteration=8, maxsize=12, seed=0,
+    scheduler="device", save_to_file=False,
+    on_peer_loss="rejoin",
+    heartbeat_every_seconds=1.0,
+)
+res = equation_search(X, y, options=options, niterations=60, verbosity=0)
+best = min(m.loss for m in res.pareto_frontier)
+print(f"RESULT p{{pid}} best={{best:.6g}} dead={{sorted(dist.dead_peers())}}",
+      flush=True)
+"""
+
+
+def _launch_elastic(script, coord, pid, fault_spec=None, join=False):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SR_COORD_DIR"] = coord
+    env["SR_ELASTIC_WORLD"] = "2"
+    env["SR_ELASTIC_ID"] = str(pid)
+    # shorter than the ~20 s a restarted worker needs to boot + compile, so
+    # the survivor formalizes the LEAVE (epoch N) before the restart can
+    # announce — the rejoin then lands at a strictly later epoch. Still
+    # comfortably above the paced 0.6 s/post cadence and initial-boot skew.
+    env["SR_KV_TIMEOUT_MS"] = "15000"
+    env["SR_KV_BACKOFF_MS"] = "50"
+    env.pop("SR_FAULT_SPEC", None)
+    env.pop("SR_ELASTIC_JOIN", None)
+    if fault_spec:
+        env["SR_FAULT_SPEC"] = fault_spec
+    if join:
+        env["SR_ELASTIC_JOIN"] = "1"
+    return subprocess.Popen(
+        [sys.executable, script],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO,
+    )
+
+
+def _elastic_epoch_records(coord):
+    import pickle
+    import urllib.parse
+
+    out = []
+    for fn in os.listdir(coord):
+        key = urllib.parse.unquote(fn)
+        if key.startswith("srep/"):
+            with open(os.path.join(coord, fn), "rb") as f:
+                out.append(pickle.load(f))
+    return sorted(out, key=lambda r: r["epoch"])
+
+
+def _result_best(out, pid):
+    line = next(
+        (l for l in out.splitlines() if l.startswith(f"RESULT p{pid}")), None
+    )
+    if line is None:
+        raise SystemExit(f"FAIL: no RESULT line from process {pid}:\n{out}")
+    return float(line.split("best=")[1].split()[0]), line
+
+
+def smoke_elastic_rejoin() -> None:
+    # the survivor is paced ~0.6 s per exchange post (slow_peer at every
+    # call count) so the ~20 s the restarted worker needs to boot + compile
+    # fits inside the survivor's remaining iterations; collectives throttle
+    # every other rank to the same cadence, so one paced rank paces the run
+    # pace EVERY survivor post (~2 posts/iteration x 60 iterations) so the
+    # restarted worker's ~20 s boot+compile lands well before the run ends,
+    # leaving a long joint phase for the frontier to re-converge after rejoin
+    pacing = ";".join(f"slow_peer@{i}:delay_ms=600" for i in range(400))
+    with tempfile.TemporaryDirectory() as d:
+        script = os.path.join(d, "worker.py")
+        with open(script, "w") as f:
+            f.write(_ELASTIC_WORKER.format(repo=REPO))
+
+        # --- no-fault reference run (its own coordination dir) --------------
+        coord_ref = os.path.join(d, "coord_ref")
+        ref = [
+            _launch_elastic(script, coord_ref, 0),
+            _launch_elastic(script, coord_ref, 1),
+        ]
+        ref_outs = [p.communicate(timeout=600)[0] for p in ref]
+        for i, (p, out) in enumerate(zip(ref, ref_outs)):
+            if p.returncode != 0:
+                raise SystemExit(
+                    f"FAIL: no-fault elastic worker {i} rc={p.returncode}:\n{out}"
+                )
+        ref_best, _ = _result_best(ref_outs[0], 0)
+
+        # --- faulted run: kill worker 1 at iteration 3, restart it ----------
+        coord = os.path.join(d, "coord")
+        survivor = _launch_elastic(script, coord, 0, fault_spec=pacing)
+        victim = _launch_elastic(script, coord, 1, fault_spec="peer_death@3")
+        victim_out = victim.communicate(timeout=600)[0]
+        if victim.returncode != 43:
+            raise SystemExit(
+                f"FAIL: victim rc={victim.returncode} (expected injected "
+                f"peer_death exit 43):\n{victim_out}"
+            )
+        rejoiner = _launch_elastic(script, coord, 1, join=True)
+        rejoin_out = rejoiner.communicate(timeout=600)[0]
+        surv_out = survivor.communicate(timeout=600)[0]
+        if rejoiner.returncode != 0:
+            raise SystemExit(
+                f"FAIL: restarted worker rc={rejoiner.returncode}:\n{rejoin_out}"
+            )
+        if survivor.returncode != 0:
+            raise SystemExit(
+                f"FAIL: survivor rc={survivor.returncode}:\n{surv_out}"
+            )
+
+        records = _elastic_epoch_records(coord)
+        kills = [r for r in records if 1 in r.get("left", [])]
+        joins = [r for r in records if 1 in r.get("joined", [])]
+        if not kills:
+            raise SystemExit(
+                f"FAIL: no epoch record names rank 1 dead: {records}"
+            )
+        if not joins:
+            raise SystemExit(
+                f"FAIL: rank 1 never rejoined (epoch records: {records})\n"
+                f"survivor:\n{surv_out}\nrejoiner:\n{rejoin_out}"
+            )
+        if joins[0]["epoch"] <= kills[0]["epoch"]:
+            raise SystemExit(
+                f"FAIL: rejoin epoch {joins[0]['epoch']} not after the kill "
+                f"epoch {kills[0]['epoch']}"
+            )
+        surv_best, surv_line = _result_best(surv_out, 0)
+        if "dead=[]" not in surv_line:
+            raise SystemExit(
+                f"FAIL: survivor still records rank 1 dead after the rejoin: "
+                f"{surv_line}"
+            )
+        # tolerance: the faulted run loses a few of rank 1's iterations but
+        # must still land a comparable frontier on this easy target
+        if not (surv_best <= max(ref_best * 100.0, 0.05)):
+            raise SystemExit(
+                f"FAIL: faulted-run frontier degraded: best={surv_best:.6g} "
+                f"vs no-fault best={ref_best:.6g}"
+            )
+    print(
+        f"OK elastic rejoin: kill epoch {kills[0]['epoch']} -> rejoin epoch "
+        f"{joins[0]['epoch']}, best {surv_best:.3g} (no-fault {ref_best:.3g})"
+    )
+
+
 if __name__ == "__main__":
-    smoke_checkpoint_resume()
-    smoke_degraded_exchange()
+    which = set(sys.argv[1:]) or {"all"}
+    unknown = which - {"all", "checkpoint", "exchange", "elastic"}
+    if unknown:
+        sys.exit(f"unknown cycle(s): {sorted(unknown)} "
+                 "(choose from: checkpoint exchange elastic)")
+    if which & {"all", "checkpoint"}:
+        smoke_checkpoint_resume()
+    if which & {"all", "exchange"}:
+        smoke_degraded_exchange()
+    if which & {"all", "elastic"}:
+        smoke_elastic_rejoin()
     print("FAULT_SMOKE=pass")

@@ -1728,9 +1728,14 @@ def device_search_one_output(
     # ships whole pickled Populations through the head process for the same
     # purpose, /root/reference/src/SymbolicRegression.jl:837-1064).
     from ..parallel import distributed as dist
+    from ..parallel import membership
 
-    n_proc = jax.process_count()
-    proc_id = jax.process_index()
+    # world identity: jax.distributed's process count/index, or the elastic
+    # rig's SR_ELASTIC_WORLD/SR_ELASTIC_ID (a logical world over a shared
+    # coordination directory, with NO jax.distributed runtime — the only way
+    # a RESTARTED process can come back, since it cannot re-register with a
+    # live coordination service)
+    n_proc, proc_id = dist.world_shape()
     multi_host = n_proc > 1
     head = proc_id == 0
 
@@ -1990,14 +1995,7 @@ def device_search_one_output(
             )
     else:
         init_trees = Population.random_trees(I * P, options, dataset.n_features, rng)
-    flat = flatten_trees(init_trees, N, dtype=eng_dt)
 
-    # score initial members on device (stay async: losses remain on device)
-    batch0 = Tree(
-        jnp.asarray(flat.kind), jnp.asarray(flat.op), jnp.asarray(flat.lhs),
-        jnp.asarray(flat.rhs), jnp.asarray(flat.feat), jnp.asarray(flat.val),
-        jnp.asarray(flat.length),
-    )
     if rows_axis:
         # host-triggered scoring (init, warm-start rescore, simplify pool)
         # reuses the sharded dataset through a replicated-batch shard_map:
@@ -2019,26 +2017,41 @@ def device_search_one_output(
         score_call = lambda batch: _sc_sharded(batch, score_data)  # noqa: E731
     else:
         score_call = lambda batch: score_fn.jitted(batch, score_data)  # noqa: E731
-    init_losses = score_call(batch0)
-    if cfg.units_check:
-        # the SAME in-jit structure-only check the engine applies — host
-        # legs must not mix a second (value-latching) penalty semantics
-        # into one search (decoded ENGINE losses already carry the penalty)
-        from ..ops.evolve import dim_penalty_batch_jit
-
-        init_losses = init_losses + dim_penalty_batch_jit(batch0, ecfg)
 
     seed = int(rng.integers(0, 2**31 - 1))
-    state = init_state(flat, np.zeros(I * P), ecfg, seed)
-    # overwrite host-zero losses with the device-computed ones (keeps the
-    # whole init path free of device->host copies)
-    from ..ops.evolve import _complexity_members
 
-    comp = _complexity_members(state, ecfg).astype(jnp.float32)
-    loss_dev = init_losses.reshape(I, P)
-    state = state._replace(
-        loss=loss_dev, score=_score_of(loss_dev, comp, cfg)  # real-baseline
-    )
+    def build_state(trees):
+        """Host trees -> scored device EvoState. Runs at init and again when
+        an elastic joiner adopts a checkpoint shard (the shard's trees
+        replace the warm-up state's random ones)."""
+        bflat = flatten_trees(trees, N, dtype=eng_dt)
+        # score initial members on device (stay async: losses remain on device)
+        batch0 = Tree(
+            jnp.asarray(bflat.kind), jnp.asarray(bflat.op),
+            jnp.asarray(bflat.lhs), jnp.asarray(bflat.rhs),
+            jnp.asarray(bflat.feat), jnp.asarray(bflat.val),
+            jnp.asarray(bflat.length),
+        )
+        b_losses = score_call(batch0)
+        if cfg.units_check:
+            # the SAME in-jit structure-only check the engine applies — host
+            # legs must not mix a second (value-latching) penalty semantics
+            # into one search (decoded ENGINE losses already carry the penalty)
+            from ..ops.evolve import dim_penalty_batch_jit
+
+            b_losses = b_losses + dim_penalty_batch_jit(batch0, ecfg)
+        st = init_state(bflat, np.zeros(I * P), ecfg, seed)
+        # overwrite host-zero losses with the device-computed ones (keeps the
+        # whole init path free of device->host copies)
+        from ..ops.evolve import _complexity_members
+
+        comp = _complexity_members(st, ecfg).astype(jnp.float32)
+        loss_dev = b_losses.reshape(I, P)
+        return bflat, st._replace(
+            loss=loss_dev, score=_score_of(loss_dev, comp, cfg)  # real-baseline
+        )
+
+    flat, state = build_state(init_trees)
 
     replay = None
     if options.use_recorder:
@@ -2067,6 +2080,11 @@ def device_search_one_output(
     if async_rb is None:
         async_rb = replay is None and not options.profile
     if replay is not None or options.profile:
+        async_rb = False
+    if multi_host and membership.elastic_enabled(options):
+        # elastic membership admits joiners at iteration boundaries; the
+        # one-slot pipelined exchange would straddle an epoch bump (the
+        # stashed payload was posted under the pre-join epoch's keys)
         async_rb = False
 
     if mesh is not None:
@@ -2314,12 +2332,107 @@ def device_search_one_output(
             state, score_data, ecfg, score_fn, copt_impl, fin_sfn
         )
     device_evals = 0.0
+    own_dev_evals = 0.0  # this process's cumulative device evals (group mode)
+    it_start = 0
+
+    # --- elastic membership (round 11): route the exchange through a
+    # per-search ExchangeGroup whenever the KV transport carries it anyway
+    # (multi-process CPU rig) or elasticity was requested. Created AFTER all
+    # AOT warmup so a joiner never holds up survivors while it compiles.
+    use_group = multi_host and membership.should_use_group(options)
+    grp = None
+    _cur_it = [0]  # shard_provider's view of the loop counter
+
+    if use_group:
+
+        def _shard_provider() -> bytes:
+            # the leader publishes this process's state as a format-2
+            # checkpoint shard when a joiner is admitted — the identical
+            # (verified-on-load) encoding the on-disk snapshots use
+            from ..utils.checkpoint import dump_checkpoint_bytes
+
+            ck_pops, _, _ = _decode_state_populations(state, I, P, cfg, options)
+            return dump_checkpoint_bytes(
+                SearchCheckpoint(
+                    iteration=int(_cur_it[0]),
+                    niterations=niterations,
+                    scheduler="device",
+                    exact=False,
+                    populations=ck_pops,
+                    hall_of_fame=hof.copy(),
+                    num_evals=float(num_evals),
+                    options_fingerprint=options_fingerprint(options),
+                    wall_time=time.time() - start_time,
+                    out_j=out_j,
+                )
+            )
+
+        grp = membership.ExchangeGroup(
+            membership.coord_store(),
+            membership.next_group_id(out_j),
+            proc_id,
+            n_proc,
+            on_peer_loss=options.on_peer_loss,
+            topology=options.exchange_topology,
+            heartbeat_every=options.heartbeat_every_seconds,
+            shard_provider=_shard_provider,
+        )
+        if membership.join_pending():
+            # JOINER: announce only now — compile/warmup is done, so the
+            # admission-to-first-collective gap is state rebuild only —
+            # then adopt the leader's shard and re-enter at the recorded
+            # iteration boundary (one-iteration-stale semantics, same as
+            # the pipelined exchange)
+            from ..utils.checkpoint import CheckpointError, load_checkpoint_bytes
+
+            record, shard = grp.join()
+            it_start = int(record.get("iteration", 0))
+            _cur_it[0] = it_start
+            if shard is not None:
+                try:
+                    ck = load_checkpoint_bytes(shard)
+                    strees = [
+                        m.tree for pop in ck.populations for m in pop.members
+                    ][: I * P]
+                    if len(strees) < I * P:
+                        strees.extend(
+                            Population.random_trees(
+                                I * P - len(strees), options,
+                                dataset.n_features, rng,
+                            )
+                        )
+                    flat, state = build_state(strees)
+                    for m in ck.hall_of_fame.members:
+                        if m is not None:
+                            hof.update(m.copy(), options)
+                except CheckpointError as e:
+                    warnings.warn(
+                        f"rejoin shard rejected ({e}); warm-starting from "
+                        "random populations instead"
+                    )
+            if verbosity > 0:
+                print(
+                    f"[device] rank {proc_id} rejoined at epoch {grp.epoch} "
+                    f"(iteration {it_start}/{niterations}, live={grp.live})"
+                )
+
+    # hierarchical exchange, LOCAL stage: with a sharded mesh the per-island
+    # topn shards merge on-device over ICI (donated buffers, replicated
+    # output) BEFORE the host exchange, so the inter-host stage ships one
+    # already-merged pool per process instead of per-device shards
+    pool_merge = None
+    if use_group and mesh is not None and options.migration:
+        from ..parallel.mesh import intra_host_pool_merge
+
+        pool_merge = intra_host_pool_merge(mesh)
+
     # pipelined-loop carry: iteration i-1's packed readback (single-host) /
-    # the double-buffered exchange slot (multi-host)
+    # the double-buffered exchange slot (multi-host; the group carries its
+    # own one-slot buffer via roll/flush)
     pending_rb = None
     exchange = (
         dist.DoubleBufferedExchange(on_peer_loss=options.on_peer_loss)
-        if (multi_host and async_rb)
+        if (multi_host and async_rb and grp is None)
         else None
     )
     known_dead = set(dist.dead_peers())
@@ -2447,10 +2560,22 @@ def device_search_one_output(
                 it_label,
             )
 
-    for it in range(niterations):
+    for it in range(it_start, niterations):
         # simulated preemption (fault-injection harness); counts one call
         # per iteration on every process that carries the spec
         injector.maybe_die("peer_death")
+        if injector.armed("nan_flood"):
+            # poison a fraction of this process's islands' losses — the NaN
+            # storm the tournament selection + pool-injection guards must
+            # wash out (migrate_from_pool/hof ignore non-finite entries)
+            hit = injector.fire("nan_flood")
+            if hit is not None:
+                frac = float(hit.get("frac", 0.75))
+                k = max(1, int(round(I * frac)))
+                bad = (jnp.arange(I) < k)[:, None]
+                state = state._replace(
+                    loss=jnp.where(bad, jnp.nan, state.loss)
+                )
         if fused_step is not None:
             # SR_FUSED_ITER: evolve → const-opt → finalize as ONE dispatch
             t_f0 = time.perf_counter()
@@ -2502,6 +2627,8 @@ def device_search_one_output(
             with prof.stage("pool_extract"):
                 _count_dispatch("pool_extract")
                 pool_dev = extract_topn_pool(state, ecfg)
+                if pool_merge is not None:
+                    pool_dev = pool_merge(*pool_dev)
                 prof.fence(pool_dev)
 
         if async_rb:
@@ -2514,7 +2641,13 @@ def device_search_one_output(
             for a in pool_dev:
                 a.copy_to_host_async()
             if multi_host:
-                gathered = exchange.roll((rb, *pool_dev))
+                if grp is not None:
+                    # srl: disable=SRL003 -- D2H after copy_to_host_async: the group transport posts host bytes, same design point as the pipelined branch below
+                    payload = tuple(np.asarray(a) for a in (rb, *pool_dev))
+                    own_dev_evals = float(_decode_readback(payload[0], cfg)[4])
+                    gathered = grp.roll(payload)
+                else:
+                    gathered = exchange.roll((rb, *pool_dev))
                 _note_lost_peers()
                 if gathered is not None:
                     _consume_readback(gathered, None, it)
@@ -2530,9 +2663,16 @@ def device_search_one_output(
                 # srl: disable=SRL003 -- the iteration's single deliberate sync point, profiled as readback_d2h
                 payload = tuple(np.asarray(a) for a in (rb, *pool_dev))
             with prof.stage("exchange"):
-                gathered = dist.all_gather_migration_pool(
-                    payload, on_peer_loss=options.on_peer_loss
-                )
+                if grp is not None:
+                    # group transport: flat (every live row) or ring (rows
+                    # [self, pred] — O(1)/step, pressure circulates the
+                    # whole ring in |live| iterations)
+                    own_dev_evals = float(_decode_readback(payload[0], cfg)[4])
+                    gathered = grp.exchange(payload)
+                else:
+                    gathered = dist.all_gather_migration_pool(
+                        payload, on_peer_loss=options.on_peer_loss
+                    )
             _note_lost_peers()
             _consume_readback(gathered, None, it + 1)
         else:
@@ -2609,15 +2749,30 @@ def device_search_one_output(
             stop_code = 4
         if multi_host:
             with prof.stage("stop_sync"):
-                stop_code = int(
-                    np.max(
-                        dist.all_gather_migration_pool(
-                            # srl: disable=SRL003 -- wraps a host int, no device transfer
-                            np.asarray([stop_code], np.int32),
-                            on_peer_loss=options.on_peer_loss,
+                if grp is not None:
+                    # the iteration's ADMISSION POINT: stop codes max-reduce,
+                    # per-process cumulative evals sum-reduce (exact under
+                    # ring topology, where the payload exchange only sees
+                    # [self, pred] rows), and any membership change —
+                    # suspects killed, announced joiners admitted — lands
+                    # here, in lockstep, with an epoch bump
+                    _cur_it[0] = it + 1
+                    stop_code, evals_sum, _admitted = grp.stop_sync(
+                        stop_code, own_dev_evals, it + 1
+                    )
+                    stop_code = int(stop_code)
+                    device_evals = evals_sum
+                    num_evals = base_evals + device_evals + host_evals
+                else:
+                    stop_code = int(
+                        np.max(
+                            dist.all_gather_migration_pool(
+                                # srl: disable=SRL003 -- wraps a host int, no device transfer
+                                np.asarray([stop_code], np.int32),
+                                on_peer_loss=options.on_peer_loss,
+                            )
                         )
                     )
-                )
             _note_lost_peers()
         prof.next_iteration()
         if stop_code:
@@ -2631,7 +2786,7 @@ def device_search_one_output(
         # payload) is still in flight. Every process reaches here on the
         # same iteration (lockstep stop), so the final gather stays uniform.
         if multi_host:
-            gathered = exchange.flush()
+            gathered = grp.flush() if grp is not None else exchange.flush()
             _note_lost_peers()
             if gathered is not None:
                 _consume_readback(gathered, None, niterations)
@@ -2675,9 +2830,14 @@ def device_search_one_output(
                     ffields, (kind, opa, lhs, rhs, feat, val)
                 ):
                     arr[s] = src[i, p]
-        g = dist.all_gather_migration_pool(
-            (fl, fn_, *ffields), on_peer_loss=options.on_peer_loss
-        )
+        if grp is not None:
+            # always FLAT, even under ring topology: the once-per-search
+            # final frontier merge must converge on every process
+            g, _, _ = grp.allgather((fl, fn_, *ffields))
+        else:
+            g = dist.all_gather_migration_pool(
+                (fl, fn_, *ffields), on_peer_loss=options.on_peer_loss
+            )
         _note_lost_peers()
         # srl: disable=SRL003 -- final hof exchange decode: runs once per search, after the engine loop
         for pi in range(np.asarray(g[0]).shape[0]):
@@ -2688,6 +2848,11 @@ def device_search_one_output(
                 bl, np.isfinite(bl), bn, flds, cfg, options
             ):
                 hof.update(m, options)
+
+    if grp is not None:
+        # stop the heartbeat thread and drop this rank's beat — the group is
+        # per-search state, nothing survives into the next equation_search
+        grp.close()
 
     # final CSV write AFTER the population decode: the decode folds the last
     # const-opt's improvements (absent from the bs-frontier readbacks) into

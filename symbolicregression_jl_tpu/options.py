@@ -266,10 +266,29 @@ class Options:
     # multi-host exchange peer-loss policy: "raise" surfaces a PeerLossError
     # naming the allgather sequence id and the missing process(es);
     # "continue" marks them dead, re-derives the live island slice, and
-    # keeps searching on the survivors with a one-iteration-stale pool.
-    # Graceful degradation applies to the KV-store transport; the XLA
-    # collective path aborts with the runtime regardless.
+    # keeps searching on the survivors with a one-iteration-stale pool;
+    # "rejoin" additionally runs the elastic membership protocol
+    # (parallel/membership.py): survivors formalize the loss as a membership
+    # -epoch bump, and a restarted process (SR_ELASTIC_JOIN=1) announces
+    # itself, adopts the latest verified checkpoint shard published by the
+    # leader, re-derives its island slice, and re-enters the exchange at the
+    # next epoch. Graceful degradation applies to the KV-store transport;
+    # the XLA collective path aborts with the runtime regardless.
     on_peer_loss: str = "raise"
+    # elastic-membership heartbeat cadence in seconds: every member's
+    # daemon thread refreshes a per-rank heartbeat key this often, so peers
+    # can distinguish "slow" from "gone" without waiting for a gather
+    # deadline. Only consulted when the elastic ExchangeGroup runtime is in
+    # play (on_peer_loss="rejoin" or SR_COORD_DIR).
+    heartbeat_every_seconds: float = 5.0
+    # inter-host exchange topology: "flat" gathers every live peer's pool on
+    # every process each iteration (O(N) reads/process); "ring" posts the
+    # local pool and reads ONLY the ring predecessor's (O(1)/process) —
+    # migration pressure still circulates the whole ring in N iterations,
+    # matching the reference's sparse island topologies. Ring requires the
+    # elastic ExchangeGroup transport (multi-process CPU KV rig or
+    # on_peer_loss="rejoin"); the XLA-collective path ignores it.
+    exchange_topology: str = "flat"
     # deterministic fault injection (utils/faults.py) — same grammar as the
     # SR_FAULT_SPEC env var, e.g. "nan_flood@2:frac=0.9;ckpt_crash@1".
     fault_spec: str | None = None
@@ -347,10 +366,17 @@ class Options:
                 "(stage fencing serializes the pipeline the async path "
                 "exists to overlap); leave async_readback=None for auto"
             )
-        if self.on_peer_loss not in ("raise", "continue"):
+        if self.on_peer_loss not in ("raise", "continue", "rejoin"):
             raise ValueError(
-                f"on_peer_loss must be 'raise' or 'continue', got "
+                f"on_peer_loss must be 'raise', 'continue', or 'rejoin', got "
                 f"{self.on_peer_loss!r}"
+            )
+        if not self.heartbeat_every_seconds > 0:
+            raise ValueError("heartbeat_every_seconds must be > 0")
+        if self.exchange_topology not in ("flat", "ring"):
+            raise ValueError(
+                f"exchange_topology must be 'flat' or 'ring', got "
+                f"{self.exchange_topology!r}"
             )
         if self.checkpoint_every is not None and self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1 (or None to disable)")

@@ -36,12 +36,16 @@ import numpy as np
 __all__ = [
     "initialize",
     "is_distributed",
+    "world_shape",
     "process_island_slice",
     "all_gather_migration_pool",
     "allgather_transport",
     "DoubleBufferedExchange",
     "PeerLossError",
     "kv_timeout_ms",
+    "kv_backoff_ms",
+    "kv_backoff_max_ms",
+    "live_set_digest",
     "dead_peers",
     "live_process_ids",
     "reset_peer_state",
@@ -76,6 +80,23 @@ def is_distributed() -> bool:
     return jax.process_count() > 1
 
 
+def world_shape() -> tuple[int, int]:
+    """(world size, this process's rank). ``SR_ELASTIC_WORLD`` /
+    ``SR_ELASTIC_ID`` override jax's process count/index — the elastic
+    file-store rigs (parallel/membership.py) define a logical world WITHOUT
+    a jax.distributed runtime, since a restarted process cannot re-register
+    with a live coordination service."""
+    import jax
+
+    w = os.environ.get("SR_ELASTIC_WORLD")
+    if w:
+        try:
+            return int(w), int(os.environ.get("SR_ELASTIC_ID", "0"))
+        except ValueError:
+            pass
+    return jax.process_count(), jax.process_index()
+
+
 def process_island_slice(
     n_islands: int, live: list[int] | None = None
 ) -> tuple[int, int]:
@@ -86,10 +107,7 @@ def process_island_slice(
     resume after a peer loss), the islands re-stripe across the surviving
     processes only — each survivor re-derives its logical ownership of the
     full island axis without the dead peers."""
-    import jax
-
-    p = jax.process_index()
-    n = jax.process_count()
+    n, p = world_shape()
     if live is not None:
         members = sorted(int(q) for q in live)
         if p not in members:
@@ -120,17 +138,62 @@ def kv_timeout_ms() -> int:
         return _KV_DEFAULT_TIMEOUT_MS
 
 
+_KV_DEFAULT_BACKOFF_MS = 250
+_KV_DEFAULT_BACKOFF_MAX_MS = 5000
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    try:
+        return max(lo, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def kv_backoff_ms() -> int:
+    """Initial per-peer poll slice in ms (``SR_KV_BACKOFF_MS``, default 250).
+    Each failed poll doubles the slice up to :func:`kv_backoff_max_ms` — a
+    coordination-service hiccup retries cheaply while a genuinely dead peer
+    still burns only the shared deadline once."""
+    return _env_int("SR_KV_BACKOFF_MS", _KV_DEFAULT_BACKOFF_MS)
+
+
+def kv_backoff_max_ms() -> int:
+    """Backoff cap in ms (``SR_KV_BACKOFF_MAX_MS``, default 5000)."""
+    return _env_int("SR_KV_BACKOFF_MAX_MS", _KV_DEFAULT_BACKOFF_MAX_MS)
+
+
+def live_set_digest(epoch: int, seq: int, live) -> str:
+    """Short stable digest of (membership epoch, collective seq, live set)
+    for barrier ids: O(1) characters at any world size (the r08 suffix
+    ``"/l0-1-2-..."`` grew O(N) and could exceed coordination-service key
+    limits at pod scale), and disjoint partitions — or stale epochs — can
+    never collide on one barrier key."""
+    import hashlib
+
+    text = f"{int(epoch)}:{int(seq)}:" + ",".join(
+        str(int(p)) for p in sorted(live)
+    )
+    return hashlib.sha1(text.encode()).hexdigest()[:12]
+
+
 class PeerLossError(RuntimeError):
     """A peer failed to post its exchange payload before the deadline.
-    Carries the allgather sequence id and the missing process ids."""
+    Carries the allgather sequence id, the missing process ids, and the
+    number of poll attempts made under the retry/backoff schedule."""
 
-    def __init__(self, seq: int, missing, timeout_ms: int):
+    def __init__(self, seq: int, missing, timeout_ms: int, attempts: int | None = None):
         self.seq = int(seq)
         self.missing = tuple(sorted(int(p) for p in missing))
+        self.attempts = None if attempts is None else int(attempts)
         peers = ", ".join(str(p) for p in self.missing)
+        tried = (
+            f" after {self.attempts} poll attempt(s)"
+            if self.attempts is not None
+            else ""
+        )
         super().__init__(
             f"allgather seq {self.seq}: process(es) {peers} failed to post "
-            f"within {timeout_ms} ms (SR_KV_TIMEOUT_MS); set "
+            f"within {timeout_ms} ms (SR_KV_TIMEOUT_MS){tried}; set "
             "on_peer_loss='continue' to keep searching on the survivors"
         )
 
@@ -141,9 +204,8 @@ def dead_peers() -> frozenset[int]:
 
 
 def live_process_ids() -> list[int]:
-    import jax
-
-    return [p for p in range(jax.process_count()) if p not in _DEAD_PEERS]
+    n, _ = world_shape()
+    return [p for p in range(n) if p not in _DEAD_PEERS]
 
 
 def reset_peer_state() -> None:
@@ -164,15 +226,17 @@ def _kv_allgather(arrays, on_peer_loss: str = "raise"):
     it), so sequence numbers stay aligned without extra synchronization.
 
     Hardening (round 8): each peer read polls in widening slices
-    (250 ms doubling to 5 s) against one shared deadline (``SR_KV_TIMEOUT_MS``)
-    instead of a single opaque blocking call, so a transient coordination
-    hiccup retries while a dead peer is named precisely. Peers that miss the
-    deadline raise :class:`PeerLossError` — or, under
+    (``SR_KV_BACKOFF_MS`` doubling to ``SR_KV_BACKOFF_MAX_MS``) against one
+    shared deadline (``SR_KV_TIMEOUT_MS``) instead of a single opaque
+    blocking call, so a transient coordination hiccup retries while a dead
+    peer is named precisely. Peers that miss the deadline raise
+    :class:`PeerLossError` (naming the poll-attempt count) — or, under
     ``on_peer_loss='continue'``, are recorded dead and excluded from every
     later gather and barrier; the returned stacks then carry one row per
     SURVIVING process (callers must iterate the leading dim, not
-    process_count). The barrier id is suffixed with the live set while
-    degraded so disjoint partitions can never collide on one barrier key."""
+    process_count). The barrier id is suffixed with a short digest of the
+    live set while degraded so disjoint partitions can never collide on one
+    barrier key."""
     global _KV_SEQ
     import io
 
@@ -190,11 +254,15 @@ def _kv_allgather(arrays, on_peer_loss: str = "raise"):
     leaves, treedef = jax.tree_util.tree_flatten(arrays)
     buf = io.BytesIO()
     np.savez(buf, *[np.asarray(a) for a in leaves])
+    injector = faults.active()
+    if injector.armed("slow_peer"):
+        hit = injector.fire("slow_peer")
+        if hit is not None:
+            time.sleep(float(hit.get("delay_ms", 1000)) / 1000.0)
     client.key_value_set_bytes(f"srag/{seq}/{pid}", buf.getvalue())
 
     timeout_ms = kv_timeout_ms()
     deadline = time.monotonic() + timeout_ms / 1000.0
-    injector = faults.active()
     fault_peers: set[int] = set()
     if injector.armed("exchange_timeout"):
         hit = injector.fire("exchange_timeout")
@@ -203,18 +271,29 @@ def _kv_allgather(arrays, on_peer_loss: str = "raise"):
             others = [p for p in live if p != pid]
             fault_peers = {int(tgt)} if tgt is not None else set(others[-1:])
 
+    backoff0 = float(kv_backoff_ms())
+    backoff_max = float(kv_backoff_max_ms())
     gathered: dict[int, list] = {}
     missing: list[int] = []
+    attempts = 0
     for p in live:
         if p in fault_peers:
             missing.append(p)
             continue
         raw = None
-        slice_ms = 250.0
+        slice_ms = backoff0
         while raw is None:
             remaining_ms = (deadline - time.monotonic()) * 1000.0
             if remaining_ms <= 0:
                 break
+            attempts += 1
+            if injector.armed("kv_flap"):
+                hit = injector.fire("kv_flap")
+                if hit is not None:
+                    # simulate a transient coordination-service failure on
+                    # this exact poll attempt: back off and retry
+                    slice_ms = min(slice_ms * 2.0, backoff_max)
+                    continue
             try:
                 raw = client.blocking_key_value_get_bytes(
                     f"srag/{seq}/{p}",
@@ -223,7 +302,7 @@ def _kv_allgather(arrays, on_peer_loss: str = "raise"):
             except Exception:  # noqa: BLE001 — a timed-out poll slice or a
                 # transient coordination-service error: back off, retry
                 # until the shared deadline
-                slice_ms = min(slice_ms * 2.0, 5000.0)
+                slice_ms = min(slice_ms * 2.0, backoff_max)
         if raw is None:
             missing.append(p)
             continue
@@ -232,7 +311,7 @@ def _kv_allgather(arrays, on_peer_loss: str = "raise"):
 
     if missing:
         if on_peer_loss != "continue":
-            raise PeerLossError(seq, missing, timeout_ms)
+            raise PeerLossError(seq, missing, timeout_ms, attempts=attempts)
         _DEAD_PEERS.update(missing)
         live = [p for p in live if p not in missing]
         warnings.warn(
@@ -244,9 +323,10 @@ def _kv_allgather(arrays, on_peer_loss: str = "raise"):
     barrier_id = f"srag-done/{seq}"
     try:
         if len(live) < n:
-            # survivors-only barrier; the live set in the id keeps disjoint
-            # partitions off one another's barrier key
-            barrier_id += "/l" + "-".join(str(p) for p in live)
+            # survivors-only barrier; a short digest of the live set keeps
+            # disjoint partitions off one another's barrier key without
+            # growing the id O(N) characters at pod scale
+            barrier_id += "/l" + live_set_digest(0, seq, live)
             client.wait_at_barrier(barrier_id, timeout_ms, process_ids=live)
         else:
             client.wait_at_barrier(barrier_id, timeout_ms)

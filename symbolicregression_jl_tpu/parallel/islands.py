@@ -158,6 +158,12 @@ def async_search_one_output(
         (/root/reference/src/SymbolicRegression.jl:1088-1129)."""
         # simulated preemption; counts one call per work unit
         injector.maybe_die("peer_death")
+        if injector.armed("slow_peer"):
+            # a straggler, not a death: the work unit stalls delay_ms before
+            # doing any work, exercising the dispatch loop's tolerance
+            hit = injector.fire("slow_peer")
+            if hit is not None:
+                time.sleep(float(hit.get("delay_ms", 1000.0)) / 1000.0)
         with lock:
             pop = pops[i].copy()
             stats = shared_stats.copy()  # deep copy per work unit

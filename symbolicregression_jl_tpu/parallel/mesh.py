@@ -23,6 +23,7 @@ __all__ = [
     "population_sharding",
     "data_sharding",
     "shard_map_compat",
+    "intra_host_pool_merge",
     "P",
 ]
 
@@ -63,6 +64,45 @@ def make_mesh(
         raise ValueError(f"mesh {n_pop}x{n_rows} != {n} devices")
     arr = np.asarray(devices).reshape(n_pop, n_rows)
     return Mesh(arr, axis_names=("pop", "rows"))
+
+
+def intra_host_pool_merge(mesh: Mesh):
+    """Build the hierarchical exchange's LOCAL stage: a jitted device
+    collective that all-gathers per-island migration-pool shards along the
+    ``pop`` axis so every device (and the host, after ONE readback) sees the
+    merged local pool.
+
+    The hierarchical exchange splits the old flat O(N)-process KV gather in
+    two: (1) THIS — an on-device ``all_gather`` over ICI, donated input
+    buffers so the shards are consumed in place; (2) a sparse inter-host
+    ring (membership.ExchangeGroup.exchange(topology='ring')) that ships
+    only the already-merged per-host pool to the ring successor. Input
+    arrays are pool leaves shaped [I_local, ...] sharded P('pop', ...);
+    outputs are fully replicated [I_total, ...] (out_specs P(None)), so the
+    caller's single ``np.asarray`` readback pulls from the host-local
+    device without cross-host traffic."""
+    import functools
+
+    def _merge(*leaves):
+        return tuple(
+            jax.lax.all_gather(lf, "pop", axis=0, tiled=True) for lf in leaves
+        )
+
+    @functools.lru_cache(maxsize=8)
+    def _build(n_leaves: int):
+        sm = shard_map_compat(
+            _merge,
+            mesh,
+            in_specs=tuple(P("pop") for _ in range(n_leaves)),
+            out_specs=tuple(P(None) for _ in range(n_leaves)),
+            check_vma=False,
+        )
+        return jax.jit(sm, donate_argnums=tuple(range(n_leaves)))
+
+    def merge(*leaves):
+        return _build(len(leaves))(*leaves)
+
+    return merge
 
 
 def population_sharding(mesh: Mesh) -> NamedSharding:

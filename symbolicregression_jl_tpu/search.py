@@ -455,6 +455,12 @@ def equation_search(
     run. Mutually exclusive with ``saved_state``.
     """
     options = options or Options()
+    # peer-death state is PER SEARCH: without this, a second equation_search
+    # in the same process would silently exclude peers that died in a
+    # previous search's exchange (the r08 _DEAD_PEERS module-global leak)
+    from .parallel import distributed as _dist
+
+    _dist.reset_peer_state()
     if parallelism is not None:
         try:
             scheduler = _PARALLELISM_TO_SCHEDULER[parallelism]

@@ -55,6 +55,8 @@ __all__ = [
     "SearchCheckpointer",
     "latest_checkpoint",
     "load_checkpoint",
+    "dump_checkpoint_bytes",
+    "load_checkpoint_bytes",
     "options_fingerprint",
 ]
 
@@ -535,6 +537,53 @@ def load_checkpoint(path: str) -> SearchCheckpoint:
     return ckpt
 
 
+def dump_checkpoint_bytes(ckpt: SearchCheckpoint) -> bytes:
+    """Serialize a snapshot to the format-2 wire encoding (flat-encoded
+    populations, highest-protocol pickle) WITHOUT touching the filesystem.
+
+    This is the elastic-membership shard format: the leader publishes these
+    bytes under a KV key when a peer joins, and the joiner decodes them with
+    :func:`load_checkpoint_bytes` — the identical (verified) representation
+    the on-disk snapshots use, so shard adoption inherits every flat-IR
+    invariant check for free."""
+    if isinstance(ckpt.populations, list):
+        flat = flatten_populations(ckpt.populations, ckpt.options_fingerprint)
+        if flat is not None:
+            ckpt = dataclasses.replace(
+                ckpt, populations=flat, format_version=CHECKPOINT_FORMAT
+            )
+    return pickle.dumps(ckpt, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_checkpoint_bytes(data: bytes) -> SearchCheckpoint:
+    """Decode + verify bytes produced by :func:`dump_checkpoint_bytes`.
+    Raises :class:`CheckpointError` on corruption, exactly like
+    :func:`load_checkpoint` does for on-disk snapshots."""
+    try:
+        ckpt = pickle.loads(data)
+    except (
+        pickle.PickleError,
+        EOFError,
+        AttributeError,
+        ImportError,
+        IndexError,
+        ValueError,
+        TypeError,
+        UnicodeDecodeError,
+    ) as e:
+        raise CheckpointError(
+            f"cannot unpickle checkpoint shard: truncated or corrupt ({e})"
+        ) from e
+    if not isinstance(ckpt, SearchCheckpoint):
+        raise CheckpointError("shard payload is not a SearchCheckpoint")
+    if isinstance(ckpt.populations, FlatPopulations):
+        try:
+            ckpt.populations = restore_populations(ckpt.populations)
+        except CheckpointError as e:
+            raise CheckpointError(f"checkpoint shard: {e}") from e
+    return ckpt
+
+
 class SearchCheckpointer:
     """Atomic rolling snapshot writer.
 
@@ -598,18 +647,11 @@ class SearchCheckpointer:
 
         # format 2: flat-encode the populations (verified on load). DAG trees
         # (graph_nodes shared subtrees) keep the format-1 raw pickling.
-        if isinstance(ckpt.populations, list):
-            flat = flatten_populations(
-                ckpt.populations, ckpt.options_fingerprint
-            )
-            if flat is not None:
-                ckpt = dataclasses.replace(
-                    ckpt, populations=flat, format_version=CHECKPOINT_FORMAT
-                )
+        data = dump_checkpoint_bytes(ckpt)
         path = f"{self.base}.{self._seq:06d}"
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(ckpt, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         hit = faults.active().fire("ckpt_crash")

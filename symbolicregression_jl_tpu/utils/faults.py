@@ -32,6 +32,15 @@ Fault sites (each scheduler documents which it consults):
 - ``nan_flood`` — a fraction (param ``frac``, default 0.75) of every
   population's losses is overwritten with NaN, the storm the non-finite
   quarantine must absorb.
+- ``peer_join`` — a joiner delays its elastic-membership announcement by
+  ``defer_ms`` (default 0) before attaching, exercising the admission
+  window (survivors must keep searching while a join is pending).
+- ``kv_flap`` — one poll attempt in the KV gather's retry loop is forced to
+  fail as if the coordination service flapped, exercising the
+  ``SR_KV_BACKOFF_MS`` schedule at an exact attempt count.
+- ``slow_peer`` — the process sleeps ``delay_ms`` (default 1000) before
+  posting its exchange payload, a straggler rather than a death: peers
+  must absorb it inside the shared deadline with no membership change.
 
 One injector is active per process at a time: ``install()`` (called by the
 schedulers when ``Options.fault_spec`` is set, resetting call counts) takes
@@ -56,7 +65,15 @@ __all__ = [
     "active",
 ]
 
-FAULT_SITES = ("exchange_timeout", "peer_death", "ckpt_crash", "nan_flood")
+FAULT_SITES = (
+    "exchange_timeout",
+    "peer_death",
+    "ckpt_crash",
+    "nan_flood",
+    "peer_join",
+    "kv_flap",
+    "slow_peer",
+)
 
 
 class FaultInjected(RuntimeError):

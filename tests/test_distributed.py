@@ -9,9 +9,13 @@ from symbolicregression_jl_tpu.parallel.distributed import (
     dead_peers,
     initialize,
     is_distributed,
+    kv_backoff_max_ms,
+    kv_backoff_ms,
     kv_timeout_ms,
+    live_set_digest,
     process_island_slice,
     reset_peer_state,
+    world_shape,
 )
 
 
@@ -56,6 +60,78 @@ def test_peer_loss_error_names_seq_and_peers():
     msg = str(err)
     assert "seq 7" in msg and "1, 3" in msg and "250 ms" in msg
     assert "SR_KV_TIMEOUT_MS" in msg and "on_peer_loss" in msg
+
+
+def test_kv_backoff_env_overrides(monkeypatch):
+    assert kv_backoff_ms() == 250
+    assert kv_backoff_max_ms() == 5000
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "40")
+    monkeypatch.setenv("SR_KV_BACKOFF_MAX_MS", "900")
+    assert kv_backoff_ms() == 40
+    assert kv_backoff_max_ms() == 900
+    # malformed values fall back to the default; out-of-range clamps to 1
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "zero")
+    monkeypatch.setenv("SR_KV_BACKOFF_MAX_MS", "-5")
+    assert kv_backoff_ms() == 250
+    assert kv_backoff_max_ms() == 1
+
+
+def test_peer_loss_error_reports_attempts():
+    err = PeerLossError(seq=2, missing=[4], timeout_ms=100, attempts=17)
+    assert err.attempts == 17
+    assert "after 17 poll attempt(s)" in str(err)
+    # attempts are optional: the r08-era constructor signature still works
+    assert "poll attempt" not in str(PeerLossError(1, [0], 50))
+
+
+def test_live_set_digest_short_stable_order_insensitive():
+    d = live_set_digest(3, 7, [0, 2, 5])
+    assert d == live_set_digest(3, 7, [5, 0, 2])
+    assert len(d) == 12 and int(d, 16) >= 0  # short hex digest
+    # any input change produces a different digest
+    assert d != live_set_digest(4, 7, [0, 2, 5])
+    assert d != live_set_digest(3, 8, [0, 2, 5])
+    assert d != live_set_digest(3, 7, [0, 2])
+    # digest length is independent of the live-set size (the point: the
+    # barrier key no longer grows O(N) with world size)
+    assert len(live_set_digest(1, 1, list(range(512)))) == 12
+
+
+def test_world_shape_env_override(monkeypatch):
+    assert world_shape() == (1, 0)  # single-process default
+    monkeypatch.setenv("SR_ELASTIC_WORLD", "4")
+    monkeypatch.setenv("SR_ELASTIC_ID", "2")
+    assert world_shape() == (4, 2)
+
+
+def test_equation_search_resets_stale_dead_peers():
+    """Regression (satellite 1): ``_DEAD_PEERS`` left over from a previous
+    degraded search must not leak into the next ``equation_search`` call."""
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.parallel import distributed as dist
+
+    dist._DEAD_PEERS.add(1)
+    try:
+        X = np.linspace(-1, 1, 32).reshape(1, -1)
+        y = 2.0 * X[0]
+        opts = Options(
+            binary_operators=["+", "*"],
+            unary_operators=[],
+            populations=2,
+            population_size=8,
+            ncycles_per_iteration=2,
+            maxsize=8,
+            seed=0,
+            progress=False,
+            verbosity=0,
+            save_to_file=False,
+        )
+        equation_search(X, y, niterations=1, options=opts)
+        assert dead_peers() == frozenset()
+    finally:
+        reset_peer_state()
 
 
 def test_dead_peer_bookkeeping_resets():

@@ -72,6 +72,20 @@ def test_parse_fault_spec_round_trip():
     assert dict(rules[2].params) == {"mode": "raise", "code": 7}
 
 
+def test_parse_fault_spec_elastic_sites():
+    """The r11 membership sites parse like any other rule."""
+    rules = faults.parse_fault_spec(
+        "peer_join@1:defer_ms=500;kv_flap@2;slow_peer@0:delay_ms=250"
+    )
+    assert [r.site for r in rules] == ["peer_join", "kv_flap", "slow_peer"]
+    assert dict(rules[0].params) == {"defer_ms": 500}
+    assert dict(rules[2].params) == {"delay_ms": 250}
+    for site in ("peer_join", "kv_flap", "slow_peer"):
+        assert site in faults.FAULT_SITES
+    # and Options validation accepts them eagerly
+    Options(fault_spec="slow_peer@0:delay_ms=10")
+
+
 @pytest.mark.parametrize(
     "bad", ["gremlin@1", "nan_flood", "nan_flood@x", "nan_flood@1:frac"]
 )
@@ -212,6 +226,38 @@ def test_nan_flood_quarantine_recovers_serial(tmp_path):
         np.isfinite(m.loss) for pop in res.populations for m in pop.members
     ]
     assert np.mean(finite) > 0.5
+
+
+def test_compound_nan_flood_then_kill_then_resume(tmp_path):
+    """Compound fault (satellite 4, serial flavor): a NaN storm at iteration 1
+    followed by preemption at iteration 3. The quarantine must absorb the
+    flood BEFORE the kill (no NaN wedge in the snapshot), and the resumed run
+    must complete with a finite frontier."""
+    X, y = _problem()
+    opts = _opts(
+        tmp_path,
+        checkpoint_every=1,
+        fault_spec="nan_flood@1:frac=0.9;peer_death@3:mode=raise",
+    )
+    with pytest.raises(faults.FaultInjected):
+        equation_search(X, y, options=opts, niterations=5, verbosity=0)
+
+    ck_base = str(tmp_path / "ck.pkl")
+    ck = load_checkpoint(ck_base)
+    assert ck.iteration == 3
+    # the snapshot taken between the two faults is not NaN-wedged
+    finite = [
+        np.isfinite(m.loss)
+        for pop in ck.populations
+        for m in pop.members
+    ]
+    assert np.mean(finite) > 0.5
+    resumed = equation_search(
+        X, y, options=_opts(tmp_path), niterations=5, verbosity=0,
+        resume_from=ck_base,
+    )
+    frontier = resumed.hall_of_fame.pareto_frontier()
+    assert frontier and all(np.isfinite(m.loss) for m in frontier)
 
 
 def test_nan_flood_quarantine_recovers_async(tmp_path):

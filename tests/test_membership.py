@@ -1,0 +1,406 @@
+"""Elastic membership runtime (parallel/membership.py): coordination stores,
+epoch-stamped collectives, deterministic kill/admit at stop_sync, join/shard
+adoption, and the ring topology — all in-process over a FileCoordStore with
+one thread per group member (no jax.distributed needed)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.parallel import distributed as dist
+from symbolicregression_jl_tpu.parallel import membership as mem
+from symbolicregression_jl_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.install(None)
+    dist.reset_peer_state()
+    yield
+    faults.install(None)
+    dist.reset_peer_state()
+
+
+def _store(tmp_path):
+    return mem.FileCoordStore(str(tmp_path / "coord"))
+
+
+def _group(store, my_id, world, **kw):
+    kw.setdefault("start_heartbeat", False)
+    return mem.ExchangeGroup(store, "t", my_id, world, **kw)
+
+
+def _run_members(fns, timeout=60.0):
+    """Run one callable per member on its own thread; re-raise any failure."""
+    errors = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        assert not t.is_alive(), "member thread hung"
+    if errors:
+        raise errors[0]
+
+
+# -- FileCoordStore -----------------------------------------------------------
+
+
+def test_file_store_set_get_delete(tmp_path):
+    st = _store(tmp_path)
+    st.set("a/b", b"one")
+    assert st.get("a/b", 100) == b"one"
+    assert st.try_get("a/b") == b"one"
+    st.set_mutable("a/b", b"two")  # overwrite-capable
+    assert st.get("a/b", 100) == b"two"
+    st.delete("a/b")
+    assert st.try_get("a/b") is None
+
+
+def test_file_store_get_timeout(tmp_path):
+    st = _store(tmp_path)
+    with pytest.raises(TimeoutError):
+        st.get("never", 80)
+
+
+def test_file_store_blocking_get_sees_late_write(tmp_path):
+    st = _store(tmp_path)
+
+    def writer():
+        import time
+
+        time.sleep(0.1)
+        st.set("late", b"v")
+
+    t = threading.Thread(target=writer)
+    t.start()
+    assert st.get("late", 5000) == b"v"
+    t.join()
+
+
+def test_file_store_barrier(tmp_path):
+    st = _store(tmp_path)
+    done = []
+
+    def member(i):
+        st.barrier("bar/x", 5000, [0, 1, 2], i)
+        done.append(i)
+
+    _run_members([lambda i=i: member(i) for i in range(3)])
+    assert sorted(done) == [0, 1, 2]
+
+
+def test_file_store_barrier_timeout(tmp_path):
+    st = _store(tmp_path)
+    with pytest.raises(TimeoutError):
+        st.barrier("bar/missing", 100, [0, 1], 0)
+
+
+# -- control rows / digest ----------------------------------------------------
+
+
+def test_control_row_roundtrip(tmp_path):
+    g = _group(_store(tmp_path), 0, 5)
+    g._suspects = {3, 1}
+    row = g._control_row({4})
+    assert row.shape == (2 + 2 * 5,)
+    j, s = mem.ExchangeGroup._parse_control(row, 5)
+    assert j == {4} and s == {1, 3}
+    empty = _group(_store(tmp_path), 0, 5)._control_row(set())
+    j, s = mem.ExchangeGroup._parse_control(empty, 5)
+    assert j == set() and s == set()
+
+
+def test_barrier_id_stamps_epoch_and_live(tmp_path):
+    g = _group(_store(tmp_path), 0, 3)
+    b0 = g._barrier_id(0)
+    g.epoch = 1
+    b1 = g._barrier_id(0)
+    assert b0 != b1  # a stale partition can't collide with the new epoch
+    g.live = [0, 1]
+    assert g._barrier_id(0) != b1
+
+
+# -- flat + ring collectives --------------------------------------------------
+
+
+def test_flat_allgather_three_members(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "10000")
+    store = _store(tmp_path)
+    groups = [_group(store, i, 3) for i in range(3)]
+    out = {}
+
+    def member(g):
+        (rows,), _, order = g.allgather((np.asarray([g.my_id * 10], np.int64),))
+        out[g.my_id] = (rows, order)
+
+    _run_members([lambda g=g: member(g) for g in groups])
+    for i in range(3):
+        rows, order = out[i]
+        assert order == [0, 1, 2]
+        assert rows[:, 0].tolist() == [0, 10, 20]
+
+
+def test_ring_exchange_reads_predecessor_only(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "10000")
+    store = _store(tmp_path)
+    groups = [_group(store, i, 3, topology="ring") for i in range(3)]
+    out = {}
+
+    def member(g):
+        (rows,) = g.exchange((np.asarray([g.my_id], np.int64),))
+        # ring keys are reclaimed at the next admission point
+        assert g._ring_keys
+        code, evals, admitted = g.stop_sync(0, 1.0, iteration=1)
+        assert not g._ring_keys
+        out[g.my_id] = (rows, code, evals, admitted)
+
+    _run_members([lambda g=g: member(g) for g in groups])
+    # rows are [self, ring predecessor]
+    assert out[0][0][:, 0].tolist() == [0, 2]
+    assert out[1][0][:, 0].tolist() == [1, 0]
+    assert out[2][0][:, 0].tolist() == [2, 1]
+    for i in range(3):
+        assert out[i][1] == 0
+        assert out[i][2] == pytest.approx(3.0)  # evals sum-reduce, flat
+        assert out[i][3] == []
+
+
+def test_stop_sync_max_code_sum_evals(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "10000")
+    store = _store(tmp_path)
+    groups = [_group(store, i, 2) for i in range(2)]
+    out = {}
+
+    def member(g, code, evals):
+        out[g.my_id] = g.stop_sync(code, evals, iteration=1)
+
+    _run_members(
+        [
+            lambda: member(groups[0], 0, 100.0),
+            lambda: member(groups[1], 3, 11.5),
+        ]
+    )
+    for i in range(2):
+        code, evals, admitted = out[i]
+        assert code == 3
+        assert evals == pytest.approx(111.5)
+
+
+# -- peer loss: raise / suspect / kill ---------------------------------------
+
+
+def test_allgather_raise_names_attempts(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "300")
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "20")
+    g = _group(_store(tmp_path), 0, 2)  # rank 1 never posts
+    with pytest.raises(dist.PeerLossError) as ei:
+        g.allgather((np.asarray([0]),))
+    assert ei.value.missing == (1,)
+    assert ei.value.attempts is not None and ei.value.attempts >= 1
+    assert "poll attempt" in str(ei.value)
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_suspect_then_kill_bumps_epoch(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "500")
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "20")
+    store = _store(tmp_path)
+    groups = [
+        _group(store, i, 3, on_peer_loss="continue") for i in range(2)
+    ]  # rank 2 never shows up
+    out = {}
+
+    def member(g):
+        # pytest.warns is not thread-safe; assert the suspicion directly
+        (rows,), _, order = g.allgather((np.asarray([g.my_id]),))
+        assert order == [0, 1]
+        assert g._suspects == {2}
+        code, evals, admitted = g.stop_sync(0, 1.0, iteration=1)
+        out[g.my_id] = (g.epoch, list(g.live), sorted(g.dead))
+
+    _run_members([lambda g=g: member(g) for g in groups])
+    for i in range(2):
+        assert out[i] == (1, [0, 1], [2])
+    assert 2 in dist.dead_peers()  # mirrored for observability
+
+
+def test_falsely_suspected_member_raises_voted_dead(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "10000")
+    store = _store(tmp_path)
+    groups = [_group(store, i, 3, on_peer_loss="continue") for i in range(3)]
+    groups[0]._suspects = {1}  # rank 0 wrongly suspects a live rank 1
+    out = {}
+
+    def member(g):
+        try:
+            g.stop_sync(0, 1.0, iteration=1)
+            out[g.my_id] = ("ok", g.epoch, list(g.live))
+        except RuntimeError as e:
+            out[g.my_id] = ("voted-dead", str(e))
+
+    _run_members([lambda g=g: member(g) for g in groups])
+    assert out[1][0] == "voted-dead"
+    assert "rejoin" in out[1][1]
+    for i in (0, 2):
+        assert out[i] == ("ok", 1, [0, 2])
+
+
+# -- join / rejoin ------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_join_admission_epoch_and_shard(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "400")
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "20")
+    store = _store(tmp_path)
+    shard = b"format2-shard-bytes"
+    survivors = [
+        _group(
+            store, i, 3, on_peer_loss="rejoin",
+            shard_provider=(lambda: shard) if i == 0 else None,
+        )
+        for i in range(2)
+    ]
+    out = {}
+    joiner_ready = threading.Event()
+
+    def survivor(g):
+        # phase A: rank 2 misses the deadline -> suspect -> killed at the
+        # admission point (epoch 1)
+        g.allgather((np.asarray([g.my_id]),))
+        assert g._suspects == {2}
+        g.stop_sync(0, 1.0, iteration=1)
+        assert g.epoch == 1 and g.live == [0, 1]
+        joiner_ready.set()
+        # phase B: keep iterating until the joiner's announcement is admitted
+        admitted = []
+        for i in range(40):
+            g.exchange((np.asarray([g.my_id]),))
+            _, _, adm = g.stop_sync(0, 1.0, iteration=2 + i)
+            if adm:
+                admitted = adm
+                break
+        assert admitted == [2]
+        # post-join collective: all three ranks, same epoch, seq 0
+        (rows,), _, order = g.allgather((np.asarray([g.my_id]),))
+        out[g.my_id] = (g.epoch, order, rows[:, 0].tolist())
+
+    def joiner():
+        joiner_ready.wait(30)
+        g2 = _group(store, 2, 3, on_peer_loss="rejoin")
+        record, got_shard = g2.join(timeout_ms=30000)
+        assert record["epoch"] == g2.epoch >= 2
+        assert 2 in record["live"] and record["joined"] == [2]
+        assert record["iteration"] >= 2
+        assert got_shard == shard
+        assert g2.seq == 0
+        (rows,), _, order = g2.allgather((np.asarray([2]),))
+        out[2] = (g2.epoch, order, rows[:, 0].tolist())
+
+    _run_members(
+        [lambda g=g: survivor(g) for g in survivors] + [joiner], timeout=120
+    )
+    epochs = {out[i][0] for i in range(3)}
+    assert len(epochs) == 1 and epochs.pop() >= 2
+    for i in range(3):
+        assert out[i][1] == [0, 1, 2]
+        assert out[i][2] == [0, 1, 2]
+    # the rejoined rank was un-mirrored from the dead set
+    assert 2 not in dist.dead_peers()
+
+
+# -- heartbeats / fault sites -------------------------------------------------
+
+
+def test_heartbeats_publish_ages(tmp_path):
+    store = _store(tmp_path)
+    g = mem.ExchangeGroup(
+        store, "hb", 0, 2, heartbeat_every=0.05, start_heartbeat=True
+    )
+    try:
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            alive = g.peers_alive()
+            if 0 in alive:
+                break
+            time.sleep(0.02)
+        assert 0 in alive and alive[0] < 5.0
+        assert 1 not in alive
+    finally:
+        g.close()
+    assert store.try_get(g._hb_key(0)) is None  # close drops the beat
+
+
+def test_kv_flap_forces_extra_poll_attempts(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "10")
+    store = _store(tmp_path)
+    store.set("k", b"v")
+    g = _group(store, 0, 2)
+    import time
+
+    faults.install("kv_flap@0")
+    raw, attempts = g._read_peer("k", time.monotonic() + 5.0)
+    assert raw == b"v" and attempts >= 2  # first attempt flapped
+    faults.install(None)
+    raw, attempts = g._read_peer("k", time.monotonic() + 5.0)
+    assert raw == b"v" and attempts == 1
+
+
+def test_slow_peer_delays_post(tmp_path, monkeypatch):
+    monkeypatch.setenv("SR_KV_TIMEOUT_MS", "10000")
+    import time
+
+    store = _store(tmp_path)
+    groups = [_group(store, i, 2) for i in range(2)]
+    faults.install(None)
+    out = {}
+
+    def member(g, spec):
+        if spec:
+            # per-thread determinism: only rank 0 carries the rule, via the
+            # process-wide injector installed before the threads start
+            pass
+        t0 = time.monotonic()
+        g.allgather((np.asarray([g.my_id]),))
+        out[g.my_id] = time.monotonic() - t0
+
+    faults.install("slow_peer@0:delay_ms=300")
+    _run_members(
+        [lambda: member(groups[0], True), lambda: member(groups[1], False)]
+    )
+    # exactly one post was delayed (exact-call-count rule); both members
+    # still completed inside the deadline with no membership change
+    assert groups[0].live == [0, 1] and groups[1].live == [0, 1]
+    assert max(out.values()) >= 0.25
+
+
+def test_should_use_group_and_elastic_enabled(tmp_path, monkeypatch):
+    from symbolicregression_jl_tpu.options import Options
+
+    opt = Options(binary_operators=["+"], unary_operators=[])
+    monkeypatch.delenv("SR_COORD_DIR", raising=False)
+    assert not mem.elastic_enabled(opt)
+    monkeypatch.setenv("SR_COORD_DIR", str(tmp_path))
+    assert mem.elastic_enabled(None)
+    assert isinstance(mem.coord_store(), mem.FileCoordStore)
+    monkeypatch.delenv("SR_COORD_DIR", raising=False)
+    opt2 = Options(binary_operators=["+"], unary_operators=[], on_peer_loss="rejoin")
+    assert mem.elastic_enabled(opt2)
+    # single-process world: no group, whatever the options say
+    monkeypatch.delenv("SR_ELASTIC_WORLD", raising=False)
+    assert not mem.should_use_group(opt2)
+    monkeypatch.setenv("SR_ELASTIC_WORLD", "4")
+    monkeypatch.setenv("SR_ELASTIC_ID", "1")
+    assert dist.world_shape() == (4, 1)
+    assert mem.should_use_group(opt2)

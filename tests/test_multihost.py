@@ -179,6 +179,63 @@ if dist.dead_peers():
 """
 
 
+_COMPOUND_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["SR_KV_TIMEOUT_MS"] = "4000"   # detect the dead peer in seconds
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid = int(sys.argv[1])
+ckdir = sys.argv[2]
+from symbolicregression_jl_tpu.parallel.distributed import initialize, is_distributed
+initialize(coordinator_address="localhost:{port}", num_processes=2, process_id=pid)
+assert is_distributed(), "expected a 2-process runtime"
+
+import numpy as np
+from symbolicregression_jl_tpu import Options, equation_search, load_checkpoint
+from symbolicregression_jl_tpu.utils import faults
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2, 100)).astype(np.float32)
+y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+options = Options(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    populations=4,
+    population_size=16,
+    ncycles_per_iteration=60,
+    maxsize=14,
+    save_to_file=False,
+    seed=0,
+    scheduler="device",
+    on_peer_loss="continue",
+    checkpoint_file=os.path.join(ckdir, "ck.pkl"),
+    checkpoint_every=1,
+    # process 1 is preempted at iteration 2; the SURVIVOR takes a second
+    # fault after it is already degraded
+    fault_spec=("peer_death@2" if pid == 1 else {survivor_spec!r}),  # noqa
+)
+try:
+    res = equation_search(X, y, options=options, niterations=4, verbosity=0)
+except faults.CheckpointWriteCrash:
+    # the crashed write must not have destroyed the previous snapshot:
+    # multihost device checkpoints are per-process (ck.pkl.p<pid>)
+    ck = load_checkpoint(os.path.join(ckdir, "ck.pkl.p0"))
+    assert ck.iteration >= 1, ck.iteration
+    print(f"CKPT_OK p{{pid}} it={{ck.iteration}}", flush=True)
+    os._exit(0)
+best = min(m.loss for m in res.pareto_frontier)
+frontier_finite = all(
+    np.isfinite(m.loss) for m in res.hall_of_fame.pareto_frontier()
+)
+from symbolicregression_jl_tpu.parallel import distributed as dist
+print(f"RESULT p{{pid}} best={{best:.6g}} finite={{frontier_finite}} "
+      f"dead={{sorted(dist.dead_peers())}}", flush=True)
+if dist.dead_peers():
+    os._exit(0)   # skip jax.distributed's all-tasks shutdown barrier
+"""
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("localhost", 0))
@@ -187,7 +244,7 @@ def _free_port():
     return port
 
 
-def _run_pair(tmp_path, template, port, timeout=900):
+def _run_pair(tmp_path, template, port, timeout=900, extra_args=()):
     script = tmp_path / "worker.py"
     script.write_text(template.format(repo=REPO, port=port))
     env = dict(os.environ)
@@ -210,7 +267,7 @@ def _run_pair(tmp_path, template, port, timeout=900):
     ).strip()
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i)],
+            [sys.executable, str(script), str(i), *map(str, extra_args)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env, cwd=REPO,
         )
@@ -294,6 +351,47 @@ def test_peer_death_raise_names_the_missing_process(tmp_path):
     assert procs[0].returncode != 0, f"survivor should have raised:\n{outs[0]}"
     assert "PeerLossError" in outs[0], outs[0]
     assert "failed to post" in outs[0] and "process(es) 1" in outs[0], outs[0]
+
+
+@pytest.mark.slow
+def test_compound_ckpt_crash_while_degraded(tmp_path):
+    """Compound fault (satellite 4): process 1 is preempted at iteration 2;
+    once the survivor is running degraded, its NEXT checkpoint write crashes
+    between the tmp write and the atomic promote (``ckpt_crash``). The
+    survivor must surface CheckpointWriteCrash — not wedge in a collective —
+    and the previous per-process snapshot must stay loadable."""
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    # checkpoint saves count 0,1,2,... per iteration (checkpoint_every=1);
+    # @2 crashes the iteration-3 save, which lands after the iteration-2 kill
+    template = _COMPOUND_WORKER.replace("{survivor_spec!r}", "'ckpt_crash@2'")
+    procs, outs = _run_pair(
+        tmp_path, template, _free_port(), extra_args=[str(ckdir)]
+    )
+    assert procs[1].returncode == 43, f"victim:\n{outs[1]}"
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0]}"
+    assert "CKPT_OK p0" in outs[0], outs[0]
+
+
+@pytest.mark.slow
+def test_compound_nan_flood_on_survivor_after_peer_death(tmp_path):
+    """Compound fault (satellite 4): after losing its peer at iteration 2,
+    the survivor takes a device-side NaN storm at iteration 3 (the in-state
+    ``nan_flood`` site poisons the scored losses directly). The quarantine
+    must absorb it and the degraded search must still finish with a finite
+    frontier."""
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    template = _COMPOUND_WORKER.replace(
+        "{survivor_spec!r}", "'nan_flood@3:frac=0.9'"
+    )
+    procs, outs = _run_pair(
+        tmp_path, template, _free_port(), extra_args=[str(ckdir)]
+    )
+    assert procs[1].returncode == 43, f"victim:\n{outs[1]}"
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0]}"
+    line = next(l for l in outs[0].splitlines() if l.startswith("RESULT p0"))
+    assert "dead=[1]" in line and "finite=True" in line, line
 
 
 def test_stale_pool_migration_stays_lockstep(tmp_path):
