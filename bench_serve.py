@@ -1,0 +1,222 @@
+"""Serve-layer throughput + latency benchmark (round 12) -> SERVE_BENCH_r12.json.
+
+Measures what the multi-tenant server's warm program cache buys over
+cold-starting every job, on one resident mesh:
+
+1. **solo warm** — one warm same-bucket job alone: the reference TTFF
+   (time-to-first-frontier) the acceptance ratio is taken against.
+2. **cold baseline** — N jobs, each preceded by ``ProgramCache.clear()`` +
+   ``jax.clear_caches()``: the every-job-recompiles world the server
+   replaces. Reported as jobs/hour.
+3. **queued batches** — 10 / 100 (and 1000 with ``--full``) tiny
+   same-bucket searches submitted at once to a running server: jobs/hour,
+   p50/p99 TTFF, and the warm cache hit ratio. TTFF is reported two ways:
+   ``ttff_exec`` from job START (the search's own serving latency — the
+   acceptance metric: queue wait at 100-deep backlog is backlog policy, not
+   cache performance) and ``ttff_submit`` from submit (queue-inclusive,
+   what a tenant actually experiences at that depth).
+
+Acceptance (ISSUE r12): at 100 queued same-bucket searches, warm jobs/hour
+>= 5x the cold baseline and p50 ttff_exec <= 2x the solo warm search.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_serve.py --out SERVE_BENCH_r12.json
+    JAX_PLATFORMS=cpu python bench_serve.py --full        # adds the 1000 batch
+    JAX_PLATFORMS=cpu python bench_serve.py --quick       # 10-job batch only
+
+CPU numbers bound structure, not TPU speed: the warm/cold ratio UNDERSTATES
+the TPU gain (the r04 measurement: ~53s compile vs ~2s warm on TPU; CPU
+compiles are faster and searches slower, compressing the ratio).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts():
+    from symbolicregression_jl_tpu import Options
+
+    return Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+
+
+def _pctl(values, p):
+    if not values:
+        return None
+    v = sorted(values)
+    k = min(len(v) - 1, max(0, int(round(p / 100 * (len(v) - 1)))))
+    return v[k]
+
+
+def _run_batch(n_jobs, X, y, workers):
+    """Submit n_jobs at once to a fresh (but cache-warm) server; return
+    throughput + TTFF stats."""
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
+    from symbolicregression_jl_tpu.serve.program_cache import global_program_cache
+
+    cache = global_program_cache()
+    before = cache.stats()
+    t0 = time.time()
+    with SearchServer(max_concurrency=workers) as srv:
+        ids = [
+            srv.submit(
+                JobSpec(
+                    X,
+                    y,
+                    options=_opts(),
+                    niterations=1,
+                    tenant=f"t{i % 2}",
+                    label=f"q{i}",
+                )
+            )
+            for i in range(n_jobs)
+        ]
+        jobs = [srv.wait(i, timeout=24 * 3600) for i in ids]
+    wall = time.time() - t0
+    after = cache.stats()
+    assert all(j.state == DONE for j in jobs), [j.summary() for j in jobs]
+    ttff_submit = [j.ttff for j in jobs if j.ttff is not None]
+    ttff_exec = [
+        j.submitted_at + j.ttff - j.started_at
+        for j in jobs
+        if j.ttff is not None and j.started_at is not None
+    ]
+    d_hits = after["hits"] - before["hits"]
+    d_miss = after["misses"] - before["misses"]
+    return {
+        "jobs": n_jobs,
+        "workers": workers,
+        "wall_s": round(wall, 2),
+        "jobs_per_hour": round(n_jobs / wall * 3600, 1),
+        "ttff_exec_p50_s": round(_pctl(ttff_exec, 50), 3),
+        "ttff_exec_p99_s": round(_pctl(ttff_exec, 99), 3),
+        "ttff_submit_p50_s": round(_pctl(ttff_submit, 50), 3),
+        "ttff_submit_p99_s": round(_pctl(ttff_submit, 99), 3),
+        "warm_hit_ratio": round(
+            d_hits / (d_hits + d_miss) if d_hits + d_miss else 0.0, 4
+        ),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default="SERVE_BENCH_r12.json")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--cold-jobs", type=int, default=3)
+    ap.add_argument("--quick", action="store_true", help="10-job batch only")
+    ap.add_argument("--full", action="store_true", help="add the 1000 batch")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
+    from symbolicregression_jl_tpu.serve.program_cache import global_program_cache
+
+    X, y = _problem()
+    cache = global_program_cache()
+
+    # -- cold baseline: every job pays the full compile --------------------------
+    print(f"cold baseline ({args.cold_jobs} jobs, cache cleared per job)...")
+    cold_times = []
+    for i in range(args.cold_jobs):
+        cache.clear()
+        jax.clear_caches()
+        t0 = time.time()
+        equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+        cold_times.append(time.time() - t0)
+        print(f"  cold job {i}: {cold_times[-1]:.1f}s")
+    cold_mean = sum(cold_times) / len(cold_times)
+    cold = {
+        "jobs": args.cold_jobs,
+        "mean_duration_s": round(cold_mean, 2),
+        "jobs_per_hour": round(3600 / cold_mean, 1),
+    }
+
+    # -- solo warm reference ----------------------------------------------------
+    # (cache is warm from the last cold job; run one throwaway then measure)
+    equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    with SearchServer(max_concurrency=1) as srv:
+        jid = srv.submit(JobSpec(X, y, options=_opts(), niterations=1))
+        job = srv.wait(jid, timeout=3600)
+        assert job.state == DONE, job.summary()
+        solo = {
+            "ttff_s": round(job.ttff, 3),
+            "duration_s": round(job.finished_at - job.started_at, 3),
+        }
+    print(f"solo warm: ttff={solo['ttff_s']}s duration={solo['duration_s']}s")
+
+    # -- queued batches ---------------------------------------------------------
+    batches = [10] if args.quick else ([10, 100, 1000] if args.full else [10, 100])
+    queued = {}
+    for n in batches:
+        print(f"queued batch: {n} jobs x {args.workers} workers...")
+        queued[str(n)] = _run_batch(n, X, y, args.workers)
+        print(f"  {queued[str(n)]}")
+    if not args.full and not args.quick:
+        queued["1000"] = {"skipped": "run with --full (CPU wall-clock)"}
+
+    acceptance = {}
+    if "100" in queued and "jobs_per_hour" in queued["100"]:
+        q = queued["100"]
+        acceptance = {
+            "warm_vs_cold_jobs_per_hour": round(
+                q["jobs_per_hour"] / cold["jobs_per_hour"], 2
+            ),
+            "target_warm_vs_cold": 5.0,
+            "p50_ttff_exec_vs_solo_warm": round(
+                q["ttff_exec_p50_s"] / solo["ttff_s"], 2
+            ),
+            "target_p50_ttff_vs_solo": 2.0,
+        }
+
+    out = {
+        "bench": "serve",
+        "round": "r12",
+        "platform": jax.devices()[0].platform,
+        "n_devices": jax.device_count(),
+        "config": {
+            "problem": "2 cos(x1) + x0^2 - 2, n=100, float32",
+            "engine": "device scheduler, populations=4 x 16, ncycles=40, "
+            "maxsize=14, niterations=1 per job",
+        },
+        "cold_baseline": cold,
+        "solo_warm": solo,
+        "queued": queued,
+        "acceptance": acceptance,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out["acceptance"] or out, indent=2))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    return_code = main()
+    raise SystemExit(return_code)

@@ -1,0 +1,128 @@
+"""Serve-layer smoke: one in-process SearchServer, 8 tiny mixed-shape jobs,
+2 tenants, a deadline expiry, and a preempt+resume — end to end on CPU.
+
+Asserts (the CI gate):
+- every job reaches the CORRECT terminal state (6 done, 1 expired-in-queue,
+  the preempted job done with preemptions >= 1 and its FULL iteration budget);
+- streamed frontier frames decode via load_frontier_bytes and the final
+  frame carries iteration == niterations;
+- the warm program-cache hit ratio across the batch exceeds 0.5 (two shape
+  buckets compile once each; every other job runs on resident programs).
+
+Run: python scripts/serve_smoke.py
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from symbolicregression_jl_tpu import Options  # noqa: E402
+from symbolicregression_jl_tpu.serve import (  # noqa: E402
+    DONE,
+    EXPIRED,
+    JobSpec,
+    SearchServer,
+    global_program_cache,
+)
+from symbolicregression_jl_tpu.utils.checkpoint import load_frontier_bytes  # noqa: E402
+
+
+def _problem(n, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts():
+    return Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+
+
+def main() -> int:
+    t0 = time.time()
+    cache = global_program_cache()
+    cache.clear()  # clean counters: the hit-ratio assertion is batch-scoped
+    XA, yA = _problem(100)
+    XB, yB = _problem(64, seed=1)
+
+    with SearchServer(max_concurrency=1, default_quota=4) as srv:
+        # 1: low-priority long job — will be preempted by the vip job below
+        low = srv.submit(JobSpec(XA, yA, options=_opts(), niterations=6,
+                                 tenant="acme", priority=0, label="low"))
+        deadline = time.monotonic() + 600
+        while not srv.frames(low) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert srv.frames(low), "low job produced no frame within 600s"
+
+        # 2: expires in the queue — the single worker is busy with `low`
+        doomed = srv.submit(JobSpec(XA, yA, options=_opts(), niterations=1,
+                                    tenant="zeta", deadline_seconds=0.05,
+                                    label="doomed"))
+        # 3: high-priority job preempts `low` at its next iteration boundary
+        vip = srv.submit(JobSpec(XA, yA, options=_opts(), niterations=1,
+                                 tenant="zeta", priority=5, label="vip"))
+        # 4-8: warm bucket-A jobs + a second (cold) shape bucket, both tenants
+        rest = [
+            srv.submit(JobSpec(XA, yA, options=_opts(), niterations=1,
+                               tenant="acme", label=f"a{i}"))
+            for i in range(3)
+        ] + [
+            srv.submit(JobSpec(XB, yB, options=_opts(), niterations=1,
+                               tenant="zeta", label=f"b{i}"))
+            for i in range(2)
+        ]
+
+        jobs = {i: srv.wait(i, timeout=1200) for i in [low, doomed, vip] + rest}
+        for job in jobs.values():
+            assert job.terminal, job.summary()
+
+        assert jobs[doomed].state == EXPIRED, jobs[doomed].summary()
+        assert jobs[doomed].started_at is None  # expired while QUEUED
+        assert jobs[vip].state == DONE, jobs[vip].summary()
+        lj = jobs[low]
+        assert lj.state == DONE, lj.summary()
+        assert lj.preemptions >= 1, lj.summary()
+        assert lj.resume_path is not None
+        assert lj.iterations_done == 6, lj.summary()
+        for jid in [vip] + rest:
+            assert jobs[jid].state == DONE, jobs[jid].summary()
+
+        # streamed frames decode, and the last one closes the budget
+        for jid in [low, vip] + rest:
+            frames = srv.frames(jid)
+            assert frames, jobs[jid].summary()
+            upd = load_frontier_bytes(frames[-1])
+            assert upd.iteration == jobs[jid].spec.niterations
+            assert len(upd.members) >= 1
+            assert min(m.loss for m in upd.members) < 50.0
+
+        st = srv.stats()
+
+    ratio = st["warm_hit_ratio"]
+    print(f"terminal states: {[j.summary()['state'] for j in jobs.values()]}")
+    print(f"preemptions(low)={lj.preemptions} iterations_done={lj.iterations_done}")
+    print(f"program cache: {st['program_cache']['hits']} hits / "
+          f"{st['program_cache']['misses']} misses (ratio {ratio:.3f}), "
+          f"{st['program_cache']['entries']} entries")
+    assert ratio > 0.5, f"warm-hit ratio {ratio:.3f} <= 0.5"
+    print(f"serve smoke OK in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,10 @@ SRL007      compile-cache key misses an ``Options`` field its cached body
 SRL008      one-shot Pallas host packing (``loss_trees_pallas`` /
             ``batched_loss_jit(use_pallas=True)``) inside an engine hot loop
             (hot loops must hold a ``make_pallas_loss_fn`` closure)
+SRL009      direct mutation of a module-level program-cache dict outside the
+            cache API (the pre-r12 ``_SCORE_FN_CACHE``/``_AOT_CACHE`` class:
+            ad-hoc dicts fork eviction/locking policy from the unified
+            ``serve.program_cache.ProgramCache``)
 ==========  ==================================================================
 
 Suppressions: a trailing ``# srl: disable=SRL001[,SRL002] [-- reason]``
@@ -94,6 +98,14 @@ RULES = {
         "these are one-shot conveniences that re-pack the batch on the host "
         "every call; hot loops MUST hold a make_pallas_loss_fn closure "
         "(ops/scoring.py contract, promoted to a rule in r10)",
+    ),
+    "SRL009": (
+        "ad-hoc-program-cache",
+        "module-level program-cache dict mutated directly — ad-hoc cache "
+        "dicts have no lock, no bound, and no counters (the pre-r12 "
+        "_SCORE_FN_CACHE/_AOT_CACHE class, including an unlocked cross-"
+        "thread .get race); route compiled-program caching through "
+        "serve.program_cache (global_program_cache().get/put)",
     ),
 }
 
@@ -833,6 +845,67 @@ def _check_cache_keys(tree, path, findings):
                 ))
 
 
+#: dict methods that mutate in place (reads like .get/.keys are fine — the
+#: rule bans forking cache POLICY, not observing the store)
+_CACHE_DICT_MUTATORS = {"pop", "popitem", "setdefault", "update", "clear"}
+
+
+def _check_adhoc_cache_mutation(tree, path, findings):
+    """SRL009: module-level ALL-CAPS ``*CACHE*`` names bound to a dict
+    literal (``= {}`` / ``= dict()`` / ``: dict = {}``) are ad-hoc program
+    caches; any in-place mutation — subscript store, ``del``, or a mutating
+    method call — bypasses the unified ProgramCache (lock, budgets,
+    counters) and is flagged. Pure reads (membership tests, ``.get``,
+    subscript loads) are allowed."""
+    cache_names: set[str] = set()
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            target, value = node.targets[0].id, node.value
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            target, value = node.target.id, node.value
+        else:
+            continue
+        if "CACHE" not in target or target != target.upper():
+            continue
+        if isinstance(value, ast.Dict) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "dict"
+        ):
+            cache_names.add(target)
+    if not cache_names:
+        return
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in cache_names
+            and isinstance(node.ctx, (ast.Store, ast.Del))
+        ):
+            verb = "del on" if isinstance(node.ctx, ast.Del) else "store into"
+            findings.append(Finding(
+                "SRL009", path, node.lineno, node.col_offset,
+                f"direct {verb} module-level cache dict "
+                f"`{node.value.id}` — " + RULES["SRL009"][1].split(" — ")[1],
+            ))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in cache_names
+            and node.func.attr in _CACHE_DICT_MUTATORS
+        ):
+            findings.append(Finding(
+                "SRL009", path, node.lineno, node.col_offset,
+                f"`.{node.func.attr}()` on module-level cache dict "
+                f"`{node.func.value.id}` — " + RULES["SRL009"][1].split(" — ")[1],
+            ))
+
+
 # -- driver -------------------------------------------------------------------
 
 def lint_source(source: str, path: str = "<string>") -> list[Finding]:
@@ -851,6 +924,7 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     _check_key_reuse(tree, path, findings)
     _check_donated_reuse(tree, path, findings)
     _check_cache_keys(tree, path, findings)
+    _check_adhoc_cache_mutation(tree, path, findings)
 
     suppressions = _parse_suppressions(source)
     for f in findings:

@@ -265,24 +265,32 @@ def _units_config(options: Options, dataset, n_features: int) -> dict:
     )
 
 
-import threading
 from typing import NamedTuple
 
-_SCORE_FN_CACHE: dict = {}
-_SCORE_DATA_CACHE: dict = {}
-_CACHE_LOCK = threading.Lock()  # concurrent per-output searches share caches
+from ..serve.program_cache import global_program_cache
+
+# Unified program cache (round 12): score fns, ScoreData uploads, and AOT
+# executables all live in ONE thread-safe LRU (serve/program_cache.py) —
+# replacing the three r04-r10 module dicts whose caps were hardcoded 12/12/32,
+# whose evict-then-setdefault block was copy-pasted three times, and whose
+# _AOT_CACHE reads ran without the lock. Capacity: SR_PROGRAM_CACHE_SIZE
+# program entries; ScoreData device arrays: SR_SCORE_DATA_CACHE_MB bytes.
+# Concurrent searches (multi-output fits, serve/ workers) share it; builds
+# happen outside the lock and racing builders converge via put's setdefault
+# semantics.
+PROGRAM_CACHE = global_program_cache()
 
 
-def _cache_get_lru(cache: dict, key):
-    """LRU hit: dicts preserve insertion order and eviction pops the FIRST
-    entry, so a hit must re-insert its key at the end — without this,
-    alternating between >2 configs under a full cache evicts the hot entry
-    every time (cap-12 FIFO was measured doing exactly that). Caller holds
-    _CACHE_LOCK."""
-    val = cache.get(key)
-    if val is not None:
-        cache[key] = cache.pop(key)
-    return val
+def _score_data_nbytes(data) -> int:
+    """Device bytes held by a ScoreData pytree — the byte-budget charge for
+    its cache entry (entry-count budgeting let twelve toy datasets evict one
+    tenant's 100 MB upload)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(data)
+    )
 
 
 def _engine_pallas_enabled() -> bool:
@@ -359,9 +367,10 @@ def _make_score_fn(
         _engine_pallas_enabled(),
         use_pallas and _pallas_interpret(),
     )
-    with _CACHE_LOCK:
-        fn = _cache_get_lru(_SCORE_FN_CACHE, fn_key)
+    fn = PROGRAM_CACHE.get("score_fn", fn_key)
     if fn is None:
+        # build OUTSIDE the cache lock (tracing + jit wrapper are slow);
+        # put() resolves build races to one canonical closure
         n_local = X.shape[1] // rows_shards if rows_shards > 1 else X.shape[1]
         fn = _build_score_fn(
             options, use_pallas, X.shape[0], n_local, has_w,
@@ -371,10 +380,7 @@ def _make_score_fn(
             import jax
 
             fn.jitted = jax.jit(fn)
-        with _CACHE_LOCK:
-            if len(_SCORE_FN_CACHE) >= 12:
-                _SCORE_FN_CACHE.pop(next(iter(_SCORE_FN_CACHE)))
-            fn = _SCORE_FN_CACHE.setdefault(fn_key, fn)
+        fn = PROGRAM_CACHE.put("score_fn", fn_key, fn)
 
     d_key = (
         ds_key if ds_key is not None else _dataset_key(X, y, weights),
@@ -383,8 +389,7 @@ def _make_score_fn(
         float(norm),  # baseline depends on the LOSS, not just the data bytes
         rows_shards,
     )
-    with _CACHE_LOCK:
-        data = _cache_get_lru(_SCORE_DATA_CACHE, d_key)
+    data = PROGRAM_CACHE.get("score_data", d_key)
     if data is None:
         if rows_shards > 1:
             data = _make_score_data_rows(
@@ -394,10 +399,11 @@ def _make_score_fn(
             data = _make_score_data(
                 X, y, weights, use_pallas, norm=norm, need_raw=need_raw
             )
-        with _CACHE_LOCK:
-            if len(_SCORE_DATA_CACHE) >= 12:  # bound device-array retention
-                _SCORE_DATA_CACHE.pop(next(iter(_SCORE_DATA_CACHE)))
-            data = _SCORE_DATA_CACHE.setdefault(d_key, data)
+        # charged by DEVICE BYTES, not entry count: retention stays
+        # proportional to the memory actually held (SR_SCORE_DATA_CACHE_MB)
+        data = PROGRAM_CACHE.put(
+            "score_data", d_key, data, nbytes=_score_data_nbytes(data)
+        )
     return fn, data
 
 
@@ -583,7 +589,7 @@ def _build_score_fn(
         # r07 length ladder — the kernel's per-slot program loop dominates,
         # so a generation whose longest tree fits a small bucket skips the
         # dead slot tail instead of burning VPU cycles on zeros. =0 recovers
-        # the exact r07 full-N launch (baked into the _SCORE_FN_CACHE key).
+        # the exact r07 full-N launch (baked into the score-fn cache key).
         pl_bucketed = (
             _engine_pallas_enabled()
             and length_buckets_enabled()
@@ -1358,18 +1364,6 @@ def _make_const_opt_fn_pallas(
     return const_opt if (axis is not None or not jit) else jax.jit(const_opt)
 
 
-_AOT_CACHE: dict = {}
-
-
-def _aot_cache_put(key, value):
-    # sized for concurrent multi-output fits: 3 programs (iter/copt/readback)
-    # x up to ~10 outputs before eviction
-    with _CACHE_LOCK:
-        if len(_AOT_CACHE) >= 32:
-            _AOT_CACHE.pop(next(iter(_AOT_CACHE)))
-        _AOT_CACHE[key] = value
-
-
 # test seam: when set to a callable, the engine main loop reports each
 # compiled-program dispatch by name ("fused_iter", "evolve", "const_opt",
 # "finalize", "readback", "pool_extract") — the ≤2-dispatches/iteration
@@ -1711,6 +1705,9 @@ def device_search_one_output(
             f"scheduler='device' cannot honor this configuration ({reason}); "
             "use scheduler='lockstep'"
         )
+    # counters snapshot BEFORE the compile/upload phase: engine_profile
+    # reports THIS search's cache traffic (delta), not process-lifetime totals
+    cache_stats0 = PROGRAM_CACHE.stats() if options.profile else None
     if options.use_recorder and jax.process_count() > 1:
         raise ValueError(
             "use_recorder is single-process: lineage replay cannot see other "
@@ -2159,7 +2156,7 @@ def device_search_one_output(
                 options.optimizer_g_tol, _copt_env(), bucket_min(),
             ),
         )
-        fused_step = _AOT_CACHE.get(k_fused)
+        fused_step = PROGRAM_CACHE.get("aot", k_fused)
         if fused_step is None:
             from ..ops.evolve import (
                 run_iteration_fused,
@@ -2172,7 +2169,7 @@ def device_search_one_output(
             fused_step = base_fused.lower(
                 state, score_data, ecfg, score_fn, copt_impl, fin_sfn
             ).compile()
-            _aot_cache_put(k_fused, fused_step)
+            fused_step = PROGRAM_CACHE.put("aot", k_fused, fused_step)
         run_step = copt_step = fin_step = None
     elif options.jit_warmup:
         # AOT-compile (lower().compile()) bypasses the jit cache, so compiled
@@ -2186,7 +2183,7 @@ def device_search_one_output(
             (pop_shards, rows_shards) if mesh else 0,
             async_rb,  # donated executables are distinct programs
         )
-        run_step = _AOT_CACHE.get(k_iter)
+        run_step = PROGRAM_CACHE.get("aot", k_iter)
         if run_step is None:
             from ..ops.evolve import run_iteration_donated
 
@@ -2196,7 +2193,7 @@ def device_search_one_output(
                 if iter_fn is not None
                 else base_iter.lower(state, score_data, ecfg, score_fn).compile()
             )
-            _aot_cache_put(k_iter, run_step)
+            run_step = PROGRAM_CACHE.put("aot", k_iter, run_step)
         copt_step = None
         if const_opt_fn is not None:
             # dataset values travel as runtime args now — the executable is
@@ -2222,17 +2219,17 @@ def device_search_one_output(
                 use_pallas_grad, _pallas_interpret(),
                 (pop_shards, rows_shards) if mesh else 0,
             )
-            copt_step = _AOT_CACHE.get(k_copt)
+            copt_step = PROGRAM_CACHE.get("aot", k_copt)
             if copt_step is None:
                 copt_step = const_opt_fn.lower(state, score_data).compile()
-                _aot_cache_put(k_copt, copt_step)
+                copt_step = PROGRAM_CACHE.put("aot", k_copt, copt_step)
         fin_step = None
         if finalize_fn is not None:
             k_fin = (
                 "fin", cfg_local, score_fn,
                 (pop_shards, rows_shards) if mesh else 0,
             )
-            fin_step = _AOT_CACHE.get(k_fin)
+            fin_step = PROGRAM_CACHE.get("aot", k_fin)
             if fin_step is None:
                 if mesh is not None:
                     fin_step = finalize_fn.lower(state, score_data).compile()
@@ -2242,7 +2239,7 @@ def device_search_one_output(
                     fin_step = run_finalize.lower(
                         state, score_data, ecfg, score_fn
                     ).compile()
-                _aot_cache_put(k_fin, fin_step)
+                fin_step = PROGRAM_CACHE.put("aot", k_fin, fin_step)
     else:
         if iter_fn is not None:
             run_step = iter_fn
@@ -2270,10 +2267,10 @@ def device_search_one_output(
 
     if options.jit_warmup:
         k_rb = ("rb", ecfg)
-        readback_step = _AOT_CACHE.get(k_rb)
+        readback_step = PROGRAM_CACHE.get("aot", k_rb)
         if readback_step is None:
             readback_step = readback_fn.lower(state).compile()
-            _aot_cache_put(k_rb, readback_step)
+            readback_step = PROGRAM_CACHE.put("aot", k_rb, readback_step)
         if options.should_simplify:
             # prime the two lazy programs the iteration-boundary simplify
             # uses (fixed [maxsize+1] pool shapes): an all-invalid pool makes
@@ -2733,20 +2730,36 @@ def device_search_one_output(
         # early_stop/max_evals fire one iteration later than the sync path
         # (documented deviation; the stale window matches the migration lag).
         stop_code = 0
-        if early_stop is not None and any(
-            early_stop(m.loss, m.get_complexity(options))
-            for m in hof.pareto_frontier()
-        ):
-            stop_code = 1
-        elif (
-            options.timeout_in_seconds is not None
-            and time.time() - start_time > options.timeout_in_seconds
-        ):
-            stop_code = 2
-        elif options.max_evals is not None and num_evals >= options.max_evals:
-            stop_code = 3
-        elif head and stdin_reader.check_for_user_quit():
-            stop_code = 4
+        if options.iteration_callback is not None:
+            from ..search import IterationReport
+
+            if options.iteration_callback(
+                IterationReport(
+                    iteration=it + 1,
+                    niterations=niterations,
+                    hall_of_fame=hof,
+                    num_evals=float(num_evals),
+                    elapsed=time.time() - start_time,
+                )
+            ):
+                # joins the lockstep stop_sync below like every other stop:
+                # in multi-host mode any process's callback stops all
+                stop_code = 5
+        if stop_code == 0:
+            if early_stop is not None and any(
+                early_stop(m.loss, m.get_complexity(options))
+                for m in hof.pareto_frontier()
+            ):
+                stop_code = 1
+            elif (
+                options.timeout_in_seconds is not None
+                and time.time() - start_time > options.timeout_in_seconds
+            ):
+                stop_code = 2
+            elif options.max_evals is not None and num_evals >= options.max_evals:
+                stop_code = 3
+            elif head and stdin_reader.check_for_user_quit():
+                stop_code = 4
         if multi_host:
             with prof.stage("stop_sync"):
                 if grp is not None:
@@ -2777,7 +2790,8 @@ def device_search_one_output(
         prof.next_iteration()
         if stop_code:
             stop_reason = {
-                1: "early_stop", 2: "timeout", 3: "max_evals", 4: "user_quit"
+                1: "early_stop", 2: "timeout", 3: "max_evals", 4: "user_quit",
+                5: "callback",
             }[stop_code]
             break
 
@@ -2878,6 +2892,18 @@ def device_search_one_output(
     if options.profile:
         # per-stage walls of the engine loop (utils/profiling.StageProfiler);
         # bench_engine_profile.py turns this into ENGINE_PROFILE artifacts
+        cs = PROGRAM_CACHE.stats()
+        prof.set_counters(
+            "program_cache",
+            {
+                # this search's traffic, plus the live occupancy
+                "hits": cs["hits"] - cache_stats0["hits"],
+                "misses": cs["misses"] - cache_stats0["misses"],
+                "evictions": cs["evictions"] - cache_stats0["evictions"],
+                "entries": cs["entries"],
+                "data_bytes": cs["data_bytes"],
+            },
+        )
         result.engine_profile = prof.summary()
     if own_recorder:
         recorder.dump()

@@ -676,12 +676,12 @@ def pallas_supported(opset: OperatorSet, n_features: int = 2, loss_elem=None) ->
         y = np.ones((128,), np.float32)
         out = loss_trees_pallas(flat, X, y, None, opset, loss_elem)
         out.block_until_ready()
-        _SUPPORT_CACHE[key] = True
+        _SUPPORT_CACHE[key] = True  # srl: disable=SRL009 -- boolean Mosaic-probe memo, not a program store
     except Exception as e:  # noqa: BLE001 — any lowering failure means fallback
         import warnings
 
         warnings.warn(f"Pallas eval unavailable for {opset}: {type(e).__name__}: {e}")
-        _SUPPORT_CACHE[key] = False
+        _SUPPORT_CACHE[key] = False  # srl: disable=SRL009 -- boolean Mosaic-probe memo, not a program store
     return _SUPPORT_CACHE[key]
 
 
@@ -982,14 +982,14 @@ def pallas_grad_supported(
         losses, grads = fn(ints, jnp.asarray(flat.val), flat.kind.shape[1])
         losses.block_until_ready()
         grads.block_until_ready()
-        _SUPPORT_CACHE[key] = True
+        _SUPPORT_CACHE[key] = True  # srl: disable=SRL009 -- boolean Mosaic-probe memo, not a program store
     except Exception as e:  # noqa: BLE001 — any lowering failure means fallback
         import warnings
 
         warnings.warn(
             f"Pallas loss+grad unavailable for {opset}: {type(e).__name__}: {e}"
         )
-        _SUPPORT_CACHE[key] = False
+        _SUPPORT_CACHE[key] = False  # srl: disable=SRL009 -- boolean Mosaic-probe memo, not a program store
     return _SUPPORT_CACHE[key]
 
 

@@ -169,6 +169,14 @@ class Options:
     early_stop_condition: float | Callable | None = None
     timeout_in_seconds: float | None = None
     max_evals: int | None = None
+    # end-of-iteration hook: called after every completed iteration with an
+    # IterationReport (iteration, niterations, hall_of_fame, num_evals,
+    # elapsed). A truthy return stops the search with stop_reason="callback"
+    # — the serving layer (serve/) drives streaming frontier updates and
+    # cooperative preemption through this. On the pipelined device loop the
+    # report's hof/num_evals lag one iteration, the documented staleness of
+    # every consumer there; exceptions propagate and abort the search.
+    iteration_callback: Callable | None = None
     seed: int | None = None
     deterministic: bool = False
     verbosity: int | None = None
@@ -344,6 +352,10 @@ class Options:
             )
         if self.async_workers is not None and self.async_workers < 1:
             raise ValueError("async_workers must be >= 1 (or None for auto)")
+        if self.iteration_callback is not None and not callable(
+            self.iteration_callback
+        ):
+            raise ValueError("iteration_callback must be callable (or None)")
         if self.device_mutation_attempts < 1:
             raise ValueError("device_mutation_attempts must be >= 1")
         if not (self.optimizer_g_tol >= 0.0):

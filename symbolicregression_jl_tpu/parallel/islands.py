@@ -264,6 +264,23 @@ def async_search_one_output(
         )
         # stop conditions (reference :1053-1060); stop_reason writes are
         # idempotent, so no lock is needed around them
+        if options.iteration_callback is not None:
+            from ..search import IterationReport
+
+            # iteration-equivalents, like the checkpoint cadence above: the
+            # async scheduler has no global iteration boundary, so the
+            # callback fires once per completed work unit with the
+            # equivalent count
+            if options.iteration_callback(
+                IterationReport(
+                    iteration=completed[0] // n_islands,
+                    niterations=niterations,
+                    hall_of_fame=hof,
+                    num_evals=scorer.num_evals,
+                    elapsed=time.time() - start_time,
+                )
+            ):
+                stop_reason[0] = "callback"
         if early_stop is not None and any(
             early_stop(m.loss, m.get_complexity(options))
             for m in hof.pareto_frontier()

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import typing
 from typing import Any
 
 import numpy as np
@@ -37,7 +38,21 @@ from .options import Options
 from .utils.export_csv import save_hall_of_fame
 from .complexity import compute_complexity
 
-__all__ = ["equation_search", "SearchResult"]
+__all__ = ["equation_search", "SearchResult", "IterationReport"]
+
+
+class IterationReport(typing.NamedTuple):
+    """What ``Options.iteration_callback`` sees after each completed
+    iteration — enough for the serving layer to stream the frontier, enforce
+    deadlines, and decide preemption, without exposing scheduler internals.
+    ``hall_of_fame`` is the LIVE object: callbacks must copy before mutating
+    or crossing a thread boundary."""
+
+    iteration: int  # iterations COMPLETED (1-based)
+    niterations: int  # this run's total budget
+    hall_of_fame: HallOfFame
+    num_evals: float
+    elapsed: float  # seconds since the scheduler's main loop started
 
 
 @dataclasses.dataclass
@@ -356,6 +371,17 @@ def _search_one_output(
         )
 
         # stop conditions (reference: /root/reference/src/SearchUtils.jl:190-212)
+        if options.iteration_callback is not None and options.iteration_callback(
+            IterationReport(
+                iteration=iteration + 1,
+                niterations=niterations,
+                hall_of_fame=hof,
+                num_evals=scorer.num_evals,
+                elapsed=time.time() - start_time,
+            )
+        ):
+            stop_reason = "callback"
+            break
         if early_stop is not None and any(
             early_stop(m.loss, m.get_complexity(options))
             for m in hof.pareto_frontier()

@@ -1,0 +1,57 @@
+"""SR-as-a-service: multi-tenant search serving over a resident mesh.
+
+Three layers:
+
+- ``program_cache`` — the unified, thread-safe, capacity-bounded LRU holding
+  every compiled engine program (jitted score fns, AOT executables) and the
+  device-resident score datasets; replaces the three ad-hoc module dicts
+  that used to live in ``models/device_search.py``. Process-global — warm
+  across searches with or without a server.
+- ``queue`` — the job model (``JobSpec``/``Job``) and the priority +
+  warm-bucket + per-tenant-quota admission queue.
+- ``server`` — ``SearchServer``: worker threads multiplexing jobs over the
+  mesh, streaming frontier frames (format-2 bytes), enforcing deadlines,
+  and preempting/resuming via spool checkpoints.
+"""
+
+from .program_cache import (
+    ProgramCache,
+    enable_persistent_compilation_cache,
+    global_program_cache,
+)
+from .queue import (
+    CANCELLED,
+    DONE,
+    EXPIRED,
+    FAILED,
+    PREEMPTED,
+    QUEUED,
+    RUNNING,
+    TERMINAL_STATES,
+    Job,
+    JobQueue,
+    JobSpec,
+    options_digest,
+    shape_bucket,
+)
+from .server import SearchServer
+
+__all__ = [
+    "ProgramCache",
+    "global_program_cache",
+    "enable_persistent_compilation_cache",
+    "JobSpec",
+    "Job",
+    "JobQueue",
+    "SearchServer",
+    "shape_bucket",
+    "options_digest",
+    "QUEUED",
+    "RUNNING",
+    "PREEMPTED",
+    "DONE",
+    "FAILED",
+    "EXPIRED",
+    "CANCELLED",
+    "TERMINAL_STATES",
+]

@@ -1,0 +1,285 @@
+"""Unified program cache: ONE thread-safe bounded LRU for every compiled
+artifact the device engine memoizes across searches (round 12).
+
+Replaces the three ad-hoc module dicts that grew in ``models/device_search.py``
+between r04 and r10 (``_SCORE_FN_CACHE``/``_SCORE_DATA_CACHE``/``_AOT_CACHE``,
+hardcoded caps 12/12/32, three copy-pasted evict-then-setdefault blocks, and
+unlocked ``_AOT_CACHE.get`` reads that the multiplexing server would turn
+into a live race). Entries are keyed on ``(kind, key)`` where ``key`` already
+carries the shape bucket, the Options-derived config objects, and the env-gate
+set (the call sites bake those in — see the ``fn_key``/``k_*`` tuples in
+device_search.py), so one cache serves every artifact class:
+
+- **Program entries** (score fns, AOT executables; ``nbytes == 0``): bounded
+  by entry COUNT (``SR_PROGRAM_CACHE_SIZE``, default 64). Compiled programs
+  are host-memory objects of roughly uniform cost; count is the right budget.
+- **Data entries** (ScoreData device-array pytrees; ``nbytes > 0``): bounded
+  by total BYTES (``SR_SCORE_DATA_CACHE_MB``, default 256). The r04-r10
+  count-12 bound let twelve 1 KB toy datasets evict one tenant's 100 MB
+  upload — byte accounting keeps retention proportional to device memory
+  actually held.
+
+Eviction is LRU within each class (a burst of tiny datasets can never evict a
+program, and vice versa), and the most-recently-inserted entry is never
+evicted — a single dataset larger than the whole byte budget is admitted
+alone rather than rejected, so callers always get cache-or-build semantics
+and eviction can only ever cost a recompile/re-upload, never an error.
+
+Counters (hits/misses/evictions, per kind and total) are cheap plain ints
+maintained under the same lock; ``stats()`` snapshots them for
+``SearchResult.engine_profile`` and the serve-layer ``/stats`` surface.
+
+Builds must happen OUTSIDE the lock (an engine compile is tens of seconds —
+holding the lock would serialize every concurrent tenant): ``get`` then
+build then ``put``, where ``put`` has setdefault semantics and returns the
+winning value, so racing builders converge on one canonical executable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "ProgramCache",
+    "global_program_cache",
+    "enable_persistent_compilation_cache",
+]
+
+_DEFAULT_CAPACITY = 64  # program entries (score fns + AOT executables)
+_DEFAULT_DATA_MB = 256  # ScoreData device-array budget
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+
+
+class ProgramCache:
+    """Thread-safe LRU over ``(kind, key)`` with count- and byte-budgets.
+
+    ``kind`` namespaces the artifact class ("score_fn", "score_data", "aot");
+    LRU order is maintained by dict insertion order (hits re-insert at the
+    MRU end, eviction pops from the LRU front — the r10 ``_cache_get_lru``
+    semantics, now in one place instead of three).
+    """
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        data_budget_bytes: int | None = None,
+    ):
+        self.capacity = (
+            _env_int("SR_PROGRAM_CACHE_SIZE", _DEFAULT_CAPACITY)
+            if capacity is None
+            else int(capacity)
+        )
+        self.data_budget_bytes = (
+            _env_int("SR_SCORE_DATA_CACHE_MB", _DEFAULT_DATA_MB) * (1 << 20)
+            if data_budget_bytes is None
+            else int(data_budget_bytes)
+        )
+        if self.capacity < 1:
+            raise ValueError("program cache capacity must be >= 1")
+        if self.data_budget_bytes < 0:
+            raise ValueError("score-data byte budget must be >= 0")
+        self._lock = threading.RLock()
+        self._entries: dict = {}  # (kind, key) -> (value, nbytes)
+        self._data_bytes = 0
+        self._hits: dict = {}
+        self._misses: dict = {}
+        self._evictions: dict = {}
+
+    # -- core API ------------------------------------------------------------
+    def get(self, kind: str, key):
+        """LRU lookup: a hit re-inserts at the MRU end and counts a hit;
+        a miss counts a miss and returns None."""
+        k = (kind, key)
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is None:
+                self._misses[kind] = self._misses.get(kind, 0) + 1
+                return None
+            self._entries[k] = self._entries.pop(k)  # refresh to MRU
+            self._hits[kind] = self._hits.get(kind, 0) + 1
+            return ent[0]
+
+    def put(self, kind: str, key, value, nbytes: int = 0):
+        """Insert with setdefault semantics: if another thread won the build
+        race, the existing entry wins and is returned (and refreshed to MRU);
+        the loser's build is discarded. ``nbytes > 0`` marks a data entry
+        charged against the byte budget instead of the entry-count budget."""
+        k = (kind, key)
+        nbytes = int(nbytes)
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is not None:
+                self._entries[k] = self._entries.pop(k)
+                return ent[0]
+            self._entries[k] = (value, nbytes)
+            if nbytes > 0:
+                self._data_bytes += nbytes
+            self._evict_over_budget(keep=k)
+            return value
+
+    def get_or_build(self, kind: str, key, build):
+        """Convenience wrapper for call sites without side conditions: the
+        build runs OUTSIDE the lock; concurrent builders converge on one
+        canonical value through ``put``'s setdefault semantics."""
+        value = self.get(kind, key)
+        if value is not None:
+            return value
+        return self.put(kind, key, build())
+
+    def _evict_over_budget(self, keep) -> None:
+        # caller holds the lock. LRU within each class: over-count evicts the
+        # oldest PROGRAM entry, over-bytes the oldest DATA entry — one class's
+        # churn never evicts the other's entries. `keep` (the entry just
+        # inserted) is exempt, so an oversized single entry is admitted alone.
+        n_programs = sum(1 for (_, nb) in self._entries.values() if nb == 0)
+        while n_programs > self.capacity:
+            victim = next(
+                (
+                    k
+                    for k, (_, nb) in self._entries.items()
+                    if nb == 0 and k != keep
+                ),
+                None,
+            )
+            if victim is None:
+                break
+            self._entries.pop(victim)
+            self._evictions[victim[0]] = self._evictions.get(victim[0], 0) + 1
+            n_programs -= 1
+        while self._data_bytes > self.data_budget_bytes:
+            victim = next(
+                (
+                    k
+                    for k, (_, nb) in self._entries.items()
+                    if nb > 0 and k != keep
+                ),
+                None,
+            )
+            if victim is None:
+                break
+            _, nb = self._entries.pop(victim)
+            self._data_bytes -= nb
+            self._evictions[victim[0]] = self._evictions.get(victim[0], 0) + 1
+
+    # -- maintenance -----------------------------------------------------------
+    def evict(self, kind: str | None = None) -> int:
+        """Explicitly evict every entry (or every entry of one kind).
+        Returns the number evicted. A search that loses its entries mid-run
+        keeps its already-fetched references and simply recompiles next time."""
+        with self._lock:
+            victims = [
+                k
+                for k in self._entries
+                if kind is None or k[0] == kind
+            ]
+            for k in victims:
+                _, nb = self._entries.pop(k)
+                if nb > 0:
+                    self._data_bytes -= nb
+                self._evictions[k[0]] = self._evictions.get(k[0], 0) + 1
+            return len(victims)
+
+    def clear(self) -> int:
+        """Evict everything AND zero the counters (test isolation)."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self._data_bytes = 0
+            self._hits.clear()
+            self._misses.clear()
+            self._evictions.clear()
+            return n
+
+    # -- introspection ---------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self, kind: str | None = None) -> list:
+        with self._lock:
+            return [
+                k for k in self._entries if kind is None or k[0] == kind
+            ]
+
+    def stats(self) -> dict:
+        """Snapshot of the counters + occupancy — the shape that lands in
+        ``SearchResult.engine_profile["program_cache"]`` and the serve-layer
+        stats surface."""
+        with self._lock:
+            kinds = set(self._hits) | set(self._misses) | set(self._evictions)
+            by_kind = {
+                kind: {
+                    "hits": self._hits.get(kind, 0),
+                    "misses": self._misses.get(kind, 0),
+                    "evictions": self._evictions.get(kind, 0),
+                }
+                for kind in sorted(kinds)
+            }
+            hits = sum(self._hits.values())
+            misses = sum(self._misses.values())
+            return {
+                "hits": hits,
+                "misses": misses,
+                "evictions": sum(self._evictions.values()),
+                "entries": len(self._entries),
+                "data_bytes": self._data_bytes,
+                "capacity": self.capacity,
+                "data_budget_bytes": self.data_budget_bytes,
+                "hit_ratio": hits / (hits + misses) if hits + misses else 0.0,
+                "by_kind": by_kind,
+            }
+
+
+# ONE process-wide instance: concurrent searches (multi-output fits, serve
+# workers) share compiled programs through it, exactly as they shared the
+# r04-r10 module dicts — but now behind one lock and one budget.
+_GLOBAL: ProgramCache | None = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_program_cache() -> ProgramCache:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ProgramCache()
+        return _GLOBAL
+
+
+def enable_persistent_compilation_cache(path: str | None = None) -> str | None:
+    """Wire jax's on-disk XLA compilation cache so a restarted server starts
+    warm: AOT ``lower().compile()`` results are keyed by HLO fingerprint and
+    re-materialized from disk instead of recompiled (~50s -> ~2s for the
+    engine megaprogram, cf. the r04 warm/cold measurement).
+
+    ``path`` falls back to ``SR_COMPILATION_CACHE_DIR``; returns the
+    directory in use, or None when neither is set (feature off). The
+    min-compile-time/min-entry-size thresholds are lowered to zero so even
+    the small per-bucket programs persist; each knob is set best-effort —
+    older jax builds without a given config name keep the rest.
+    """
+    path = path or os.environ.get("SR_COMPILATION_CACHE_DIR")
+    if not path:
+        return None
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    for name, value in (
+        ("jax_compilation_cache_dir", path),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(name, value)
+        except (AttributeError, ValueError):  # unknown knob on this jax build
+            pass
+    return path

@@ -1,0 +1,448 @@
+"""SR-as-a-service: a long-lived multi-tenant search daemon.
+
+One resident process owns the device mesh and multiplexes many concurrent
+``equation_search`` jobs over it with a pool of worker threads. The engine's
+compiled programs are dataset-independent (device_search.py keys them on
+shapes + config, never data), so every job that lands in an already-seen
+shape bucket skips the ~50s compile and runs at the ~2s warm rate (r04) —
+the server's whole job is to keep that cache hot:
+
+- **admission** (queue.py): priority, then warm-bucket affinity, then FIFO,
+  under per-tenant concurrency quotas;
+- **budgets**: per-job wall-clock deadline (from submit; enforced while
+  queued AND while running, via the engine's own timeout stop) and eval
+  budget (``max_evals``);
+- **streaming**: after each iteration the search's live Pareto frontier is
+  encoded with the format-2 flat checkpoint codec
+  (``utils/checkpoint.dump_frontier_bytes``) and appended to the job's frame
+  list — the wire format clients decode with ``load_frontier_bytes``;
+- **preemption**: a higher-priority submission marks the lowest-priority
+  running job; its iteration callback stops the search cooperatively at the
+  next boundary, the server snapshots a format-2 checkpoint into the spool,
+  and the job re-enters the queue — the next admission passes
+  ``resume_from`` so the search warm-starts over its REMAINING iterations;
+- **warm restarts**: ``enable_persistent_compilation_cache`` wires jax's
+  on-disk XLA cache (``SR_COMPILATION_CACHE_DIR``), so even a restarted
+  server re-materializes executables from disk instead of recompiling.
+
+The server is in-process by design (the engine is a Python library; remote
+transport is a thin shell over ``submit``/``frames``/``result`` and out of
+scope here) — but every interaction goes through the queue's lock and the
+jobs' events, so a transport can drive it from any thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+from . import queue as q
+from .program_cache import enable_persistent_compilation_cache, global_program_cache
+from .queue import Job, JobQueue, JobSpec
+
+__all__ = ["SearchServer", "JobSpec"]
+
+
+class SearchServer:
+    """Multi-tenant search daemon. Typical use::
+
+        with SearchServer(max_concurrency=2) as srv:
+            jid = srv.submit(JobSpec(X, y, options=opts, niterations=5,
+                                     tenant="acme", priority=1))
+            job = srv.wait(jid, timeout=300)
+            for frame in srv.frames(jid):
+                update = load_frontier_bytes(frame)   # streaming client side
+            result = job.result                        # SearchResult
+
+    ``max_concurrency`` bounds concurrently RUNNING searches (worker
+    threads); per-tenant quotas bound each tenant's share of those slots.
+    """
+
+    def __init__(
+        self,
+        max_concurrency: int = 2,
+        default_quota: int = 2,
+        quotas: dict | None = None,
+        spool_dir: str | None = None,
+        compilation_cache_dir: str | None = None,
+        poll_seconds: float = 0.2,
+    ):
+        if max_concurrency < 1:
+            raise ValueError("max_concurrency must be >= 1")
+        self.max_concurrency = int(max_concurrency)
+        self.poll_seconds = float(poll_seconds)
+        self.cache = global_program_cache()
+        self.compilation_cache_dir = enable_persistent_compilation_cache(
+            compilation_cache_dir
+        )
+        self._own_spool = spool_dir is None
+        self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="sr-serve-spool-")
+        os.makedirs(self.spool_dir, exist_ok=True)
+        self._queue = JobQueue(default_quota=default_quota, quotas=quotas)
+        self._lock = threading.Lock()
+        self._frame_cond = threading.Condition(self._lock)
+        self._jobs: dict[str, Job] = {}
+        self._running: dict[str, Job] = {}
+        self._warm_buckets: set = set()
+        self._seq = 0
+        self._stopping = False
+        self._workers: list[threading.Thread] = []
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "SearchServer":
+        if self._started:
+            return self
+        self._started = True
+        for i in range(self.max_concurrency):
+            t = threading.Thread(
+                target=self._worker_loop, name=f"sr-serve-worker-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+        return self
+
+    def shutdown(self, wait: bool = True, cancel_queued: bool = True) -> None:
+        """Stop accepting work and stop running jobs at their next iteration
+        boundary (cooperative; running jobs finalize as CANCELLED)."""
+        self._stopping = True
+        with self._lock:
+            running = list(self._running.values())
+        for job in running:
+            job.cancel_requested.set()
+        if cancel_queued:
+            for job in self._queue.drain():
+                self._finalize(job, q.CANCELLED, release=False)
+        self._queue.wake_all()
+        if wait:
+            for t in self._workers:
+                t.join(timeout=60)
+            if cancel_queued:
+                # a preempted job may have re-entered between drain and join
+                for job in self._queue.drain():
+                    self._finalize(job, q.CANCELLED, release=False)
+        if self._own_spool:
+            shutil.rmtree(self.spool_dir, ignore_errors=True)
+
+    def __enter__(self) -> "SearchServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- client surface -------------------------------------------------------
+    def submit(self, spec: JobSpec) -> str:
+        """Enqueue a job; returns its id. May trigger preemption: when every
+        worker is busy and some RUNNING job has strictly lower priority (and
+        is preemptible), the lowest-priority one is asked to yield."""
+        if self._stopping:
+            raise RuntimeError("server is shutting down")
+        if not self._started:
+            raise RuntimeError("server not started (use start() or a with-block)")
+        with self._lock:
+            self._seq += 1
+            job_id = f"job-{self._seq:05d}"
+            job = Job(job_id, spec, seq=self._seq)
+            self._jobs[job_id] = job
+        self._queue.submit(job)
+        self._maybe_preempt_for(job)
+        return job_id
+
+    def job(self, job_id: str) -> Job:
+        with self._lock:
+            return self._jobs[job_id]
+
+    def wait(self, job_id: str, timeout: float | None = None) -> Job:
+        """Block until the job reaches a terminal state (or timeout); returns
+        the Job either way — check ``job.terminal``."""
+        job = self.job(job_id)
+        job.done_event.wait(timeout)
+        return job
+
+    def frames(self, job_id: str, start: int = 0) -> list[bytes]:
+        """Snapshot of the job's frontier frames from index ``start`` —
+        format-2 bytes for ``utils.checkpoint.load_frontier_bytes``."""
+        job = self.job(job_id)
+        with self._lock:
+            return list(job.frames[start:])
+
+    def stream(self, job_id: str, timeout: float | None = None):
+        """Generator over frontier frames as they arrive, ending when the job
+        goes terminal (yields every frame exactly once)."""
+        job = self.job(job_id)
+        i = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._frame_cond:
+                while len(job.frames) <= i and not job.terminal:
+                    remaining = (
+                        None if deadline is None else deadline - time.monotonic()
+                    )
+                    if remaining is not None and remaining <= 0:
+                        return
+                    if not self._frame_cond.wait(
+                        self.poll_seconds
+                        if remaining is None
+                        else min(self.poll_seconds, remaining)
+                    ):
+                        continue
+                batch = list(job.frames[i:])
+            for frame in batch:
+                yield frame
+            i += len(batch)
+            if job.terminal and i >= len(self.frames(job_id)):
+                return
+
+    def cancel(self, job_id: str) -> None:
+        """Request cancellation: queued jobs finalize on the next sweep,
+        running jobs stop at the next iteration boundary."""
+        self.job(job_id).cancel_requested.set()
+        self._queue.wake_all()
+
+    def stats(self) -> dict:
+        """Server + cache health: job states, warm buckets, and the unified
+        program cache's hit/miss/eviction counters (the same block the
+        engine surfaces per-search via ``engine_profile``)."""
+        with self._lock:
+            by_state: dict[str, int] = {}
+            for job in self._jobs.values():
+                by_state[job.state] = by_state.get(job.state, 0) + 1
+            cache = self.cache.stats()
+            return {
+                "jobs": by_state,
+                "queued": len(self._queue),
+                "running": len(self._running),
+                "warm_buckets": len(self._warm_buckets),
+                "program_cache": cache,
+                "warm_hit_ratio": cache["hit_ratio"],
+                "compilation_cache_dir": self.compilation_cache_dir,
+            }
+
+    # -- scheduling internals --------------------------------------------------
+    def _maybe_preempt_for(self, incoming: Job) -> None:
+        with self._lock:
+            if len(self._running) < self.max_concurrency:
+                return  # a free worker will pick the queue's best job up
+            candidates = [
+                j
+                for j in self._running.values()
+                if j.spec.preemptible
+                and not j.preempt_requested.is_set()
+                and j.spec.priority < incoming.spec.priority
+            ]
+            if not candidates:
+                return
+            victim = min(candidates, key=lambda j: (j.spec.priority, -j.seq))
+            victim.preempt_requested.set()
+
+    def _worker_loop(self) -> None:
+        while not self._stopping:
+            now = time.time()
+            for job in self._queue.take_expired(now):
+                state = (
+                    q.CANCELLED if job.cancel_requested.is_set() else q.EXPIRED
+                )
+                self._finalize(job, state, release=False)
+            job = self._queue.acquire(
+                warm_buckets=self._warm_snapshot(), timeout=self.poll_seconds
+            )
+            if job is None:
+                continue
+            if self._stopping:
+                self._queue.release(job)
+                self._finalize(job, q.CANCELLED, release=False)
+                return
+            try:
+                self._run_job(job)
+            except BaseException as e:  # a worker must never die silently
+                job.error = f"{type(e).__name__}: {e}"
+                self._queue.release(job)
+                self._finalize(job, q.FAILED, release=False)
+
+    def _warm_snapshot(self) -> set:
+        with self._lock:
+            return set(self._warm_buckets)
+
+    def _make_callback(self, job: Job, fingerprint: tuple):
+        spec = job.spec
+
+        def _on_iteration(report) -> bool | None:
+            job.iterations_done = job.iteration_base + report.iteration
+            if (
+                report.iteration % spec.stream_every == 0
+                or job.iterations_done >= spec.niterations
+            ):
+                from ..utils.checkpoint import dump_frontier_bytes
+
+                frame = dump_frontier_bytes(
+                    report.hall_of_fame,
+                    iteration=job.iterations_done,
+                    niterations=spec.niterations,
+                    num_evals=report.num_evals,
+                    fingerprint=fingerprint,
+                    wall_time=time.time() - job.submitted_at,
+                )
+                with self._frame_cond:
+                    job.frames.append(frame)
+                    if job.ttff is None:
+                        job.ttff = time.time() - job.submitted_at
+                    self._frame_cond.notify_all()
+            if (
+                job.cancel_requested.is_set()
+                or job.preempt_requested.is_set()
+                or self._stopping
+            ):
+                return True
+            return None
+
+        return _on_iteration
+
+    def _run_job(self, job: Job) -> None:
+        from ..search import equation_search
+        from ..utils.checkpoint import options_fingerprint
+
+        spec = job.spec
+        now = time.time()
+        if job.deadline_at is not None and now >= job.deadline_at:
+            self._queue.release(job)
+            self._finalize(job, q.EXPIRED, release=False)
+            return
+        with self._lock:
+            self._running[job.id] = job
+        job.started_at = job.started_at or now
+        job.iteration_base = job.iterations_done
+
+        fingerprint = options_fingerprint(spec.options)
+        timeout = spec.options.timeout_in_seconds
+        if job.deadline_at is not None:
+            remaining = job.deadline_at - now
+            timeout = remaining if timeout is None else min(timeout, remaining)
+        opts = dataclasses.replace(
+            spec.options,
+            iteration_callback=self._make_callback(job, fingerprint),
+            timeout_in_seconds=timeout,
+            max_evals=(
+                spec.max_evals
+                if spec.max_evals is not None
+                else spec.options.max_evals
+            ),
+            # the server owns persistence: no CSV sidecars, no per-job
+            # checkpoint cadence (preemption snapshots are written here)
+            save_to_file=False,
+            progress=False,
+            checkpoint_every=None,
+            checkpoint_every_seconds=None,
+        )
+        try:
+            result = equation_search(
+                spec.X,
+                spec.y,
+                weights=spec.weights,
+                options=opts,
+                niterations=spec.niterations,
+                resume_from=job.resume_path,
+                verbosity=0,
+            )
+        except BaseException as e:
+            self._release_running(job)
+            job.error = f"{type(e).__name__}: {e}"
+            self._finalize(job, q.FAILED, release=False)
+            return
+
+        job.result = result
+        job.stop_reason = getattr(result, "stop_reason", None)
+        self._release_running(job)
+
+        if job.cancel_requested.is_set() or (
+            self._stopping and job.stop_reason == "callback"
+        ):
+            self._finalize(job, q.CANCELLED, release=False)
+            return
+        if job.stop_reason == "callback" and job.preempt_requested.is_set():
+            self._preempt_requeue(job, result, fingerprint)
+            return
+        # definitive final frame from the FINISHED result: the pipelined
+        # device loop's per-iteration reports lag the hall of fame by one
+        # iteration, so the last streamed frame may undersell (or, for a
+        # 1-iteration job, miss) the final frontier
+        self._push_final_frame(job, result, fingerprint)
+        if (
+            job.stop_reason == "timeout"
+            and job.deadline_at is not None
+            and time.time() >= job.deadline_at - 0.25
+        ):
+            # the engine's timeout stop was OUR deadline, not the tenant's own
+            # timeout_in_seconds — terminal "expired", result still attached
+            self._finalize(job, q.EXPIRED, release=False)
+            return
+        self._finalize(job, q.DONE, release=False)
+
+    def _push_final_frame(self, job: Job, result, fingerprint: tuple) -> None:
+        from ..utils.checkpoint import dump_frontier_bytes
+
+        frame = dump_frontier_bytes(
+            result.hall_of_fame,
+            iteration=max(job.iterations_done, 1),
+            niterations=job.spec.niterations,
+            num_evals=float(getattr(result, "num_evals", 0.0)),
+            fingerprint=fingerprint,
+            wall_time=time.time() - job.submitted_at,
+        )
+        with self._frame_cond:
+            job.frames.append(frame)
+            if job.ttff is None:
+                job.ttff = time.time() - job.submitted_at
+            self._frame_cond.notify_all()
+
+    def _release_running(self, job: Job) -> None:
+        with self._lock:
+            self._running.pop(job.id, None)
+            # the bucket's programs are resident from this run on — admission
+            # prefers jobs that can reuse them
+            self._warm_buckets.add(job.bucket)
+        self._queue.release(job)
+
+    def _preempt_requeue(self, job: Job, result, fingerprint: tuple) -> None:
+        """Snapshot the evicted job's state (format-2, atomic write) and
+        re-enqueue it: the next admission resumes via ``resume_from`` over
+        the remaining ``niterations - iterations_done`` budget."""
+        from ..utils.checkpoint import SearchCheckpoint, dump_checkpoint_bytes
+
+        ck = SearchCheckpoint(
+            iteration=int(job.iterations_done),
+            niterations=int(job.spec.niterations),
+            scheduler=job.spec.options.scheduler,
+            exact=False,  # decoded observation -> rescored warm start
+            populations=result.populations,
+            hall_of_fame=result.hall_of_fame,
+            num_evals=float(result.num_evals),
+            options_fingerprint=fingerprint,
+            wall_time=time.time() - job.submitted_at,
+            out_j=1,
+        )
+        path = os.path.join(self.spool_dir, f"{job.id}.ckpt")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(dump_checkpoint_bytes(ck))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        job.resume_path = path
+        job.preemptions += 1
+        job.preempt_requested.clear()
+        with self._lock:
+            job.state = q.PREEMPTED
+        self._queue.resubmit(job)
+
+    def _finalize(self, job: Job, state: str, release: bool = True) -> None:
+        if release:
+            self._queue.release(job)
+        with self._frame_cond:
+            job.state = state
+            job.finished_at = time.time()
+            self._frame_cond.notify_all()
+        job.done_event.set()

@@ -57,6 +57,9 @@ __all__ = [
     "load_checkpoint",
     "dump_checkpoint_bytes",
     "load_checkpoint_bytes",
+    "FrontierUpdate",
+    "dump_frontier_bytes",
+    "load_frontier_bytes",
     "options_fingerprint",
 ]
 
@@ -352,6 +355,10 @@ def flatten_populations(populations, fingerprint=()) -> "FlatPopulations | None"
     from ..ops.flat import flatten_trees
 
     members = [m for pop in populations for m in pop.members]
+    if not members:
+        # nothing to flat-encode (e.g. an empty-frontier streaming frame):
+        # raw pickling of the empty list is exact and trivially safe
+        return None
     sizes = []
     has_complex = False
     for m in members:
@@ -582,6 +589,82 @@ def load_checkpoint_bytes(data: bytes) -> SearchCheckpoint:
         except CheckpointError as e:
             raise CheckpointError(f"checkpoint shard: {e}") from e
     return ckpt
+
+
+# -- streaming frontier frames (round 12) -------------------------------------
+#
+# The serving layer pushes incremental Pareto-frontier updates to clients as
+# the search runs. The wire format IS the format-2 checkpoint encoding: the
+# frontier members travel as one flat-encoded population (every flat-IR
+# invariant verified on decode), the hall_of_fame field stays an EMPTY stub
+# (raw tree pickling is exactly what format 2 exists to avoid), and
+# scheduler="frontier" marks the frame type so a frame is never mistaken for
+# a resumable full-state snapshot.
+
+
+class FrontierUpdate(NamedTuple):
+    """One decoded streaming frame: the Pareto frontier at ``iteration``."""
+
+    iteration: int
+    niterations: int
+    num_evals: float
+    members: list  # PopMember frontier, best-per-complexity
+    wall_time: float
+    out_j: int
+
+
+def dump_frontier_bytes(
+    hall_of_fame,
+    iteration: int = 0,
+    niterations: int = 0,
+    num_evals: float = 0.0,
+    fingerprint: tuple = (),
+    wall_time: float = 0.0,
+    out_j: int = 1,
+) -> bytes:
+    """Encode a hall-of-fame Pareto frontier as one streaming frame.
+
+    Members are copied before encoding, so the caller may pass the LIVE
+    hall of fame from an iteration callback. ``fingerprint``
+    (:func:`options_fingerprint`) supplies the operator counts for the
+    decode-side op-range checks."""
+    from ..models.hall_of_fame import HallOfFame
+    from ..models.population import Population
+
+    members = [m.copy() for m in hall_of_fame.pareto_frontier()]
+    ckpt = SearchCheckpoint(
+        iteration=int(iteration),
+        niterations=int(niterations),
+        scheduler="frontier",
+        exact=False,
+        populations=[Population(members)] if members else [],
+        hall_of_fame=HallOfFame(0),  # empty stub: the frontier travels flat
+        num_evals=float(num_evals),
+        options_fingerprint=tuple(fingerprint),
+        wall_time=float(wall_time),
+        out_j=int(out_j),
+    )
+    return dump_checkpoint_bytes(ckpt)
+
+
+def load_frontier_bytes(data: bytes) -> FrontierUpdate:
+    """Decode + verify a frame produced by :func:`dump_frontier_bytes`.
+    Raises :class:`CheckpointError` on corruption or a non-frontier payload."""
+    ckpt = load_checkpoint_bytes(data)
+    if ckpt.scheduler != "frontier":
+        raise CheckpointError(
+            f"not a frontier frame (scheduler={ckpt.scheduler!r}); full-state "
+            "snapshots resume searches, they do not stream"
+        )
+    members = [m for pop in ckpt.populations for m in pop.members]
+    return FrontierUpdate(
+        iteration=int(ckpt.iteration),
+        niterations=int(ckpt.niterations),
+        num_evals=float(ckpt.num_evals),
+        members=members,
+        wall_time=float(ckpt.wall_time),
+        out_j=int(ckpt.out_j),
+    )
 
 
 class SearchCheckpointer:

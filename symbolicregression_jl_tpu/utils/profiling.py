@@ -86,13 +86,14 @@ class StageProfiler:
     overhead and unattributed host work show up as ``other``.
     """
 
-    __slots__ = ("enabled", "_ring", "_current", "_iter_t0")
+    __slots__ = ("enabled", "_ring", "_current", "_iter_t0", "_counters")
 
     def __init__(self, enabled: bool = True, capacity: int = 512):
         self.enabled = enabled
         self._ring: deque = deque(maxlen=capacity)
         self._current: dict = {}
         self._iter_t0: float | None = None
+        self._counters: dict = {}
 
     # -- recording ----------------------------------------------------------
     def stage(self, name: str):
@@ -126,6 +127,14 @@ class StageProfiler:
         cur = self._current
         cur[name] = cur.get(name, 0.0) + seconds
 
+    def set_counters(self, name: str, values: dict):
+        """Attach a named block of event COUNTERS (not timings) to the
+        summary — e.g. the program-cache hits/misses/evictions of this
+        search. Last write per name wins; no-op when disabled."""
+        if not self.enabled:
+            return
+        self._counters[name] = dict(values)
+
     def next_iteration(self):
         """Close the current iteration's record and push it to the ring."""
         if not self.enabled:
@@ -151,8 +160,12 @@ class StageProfiler:
         mean iteration wall, plus the unattributed remainder (``other``)."""
         iters = list(self._ring)
         n = len(iters)
+        counters = {k: dict(v) for k, v in self._counters.items()}
         if n == 0:
-            return {"iterations": 0, "stages": {}, "iteration_mean_ms": 0.0}
+            out = {"iterations": 0, "stages": {}, "iteration_mean_ms": 0.0}
+            if counters:
+                out["counters"] = counters
+            return out
         walls = [r.get("_wall", 0.0) for r in iters]
         wall_mean = sum(walls) / n
         names = []
@@ -183,13 +196,16 @@ class StageProfiler:
             "total_ms": other * n * 1e3,
             "fraction": (other / wall_mean) if wall_mean > 0 else 0.0,
         }
-        return {
+        out = {
             "iterations": n,
             "iteration_mean_ms": wall_mean * 1e3,
             "iteration_p50_ms": self._pct(sorted(walls), 0.50) * 1e3,
             "iteration_p90_ms": self._pct(sorted(walls), 0.90) * 1e3,
             "stages": stages,
         }
+        if counters:
+            out["counters"] = counters
+        return out
 
 
 NULL_PROFILER = StageProfiler(enabled=False, capacity=1)

@@ -1,7 +1,7 @@
 """SRL007 clean twin: the key carries every Options field the body reads,
 including reads made through a module-local builder (the r06 fix)."""
 
-_CACHE = {}
+_memo = {}
 
 
 def _build_const_opt(options, n_slots):
@@ -12,8 +12,8 @@ def _build_const_opt(options, n_slots):
 
 def get_const_opt_fn(options, n_slots):
     key = (n_slots, options.optimizer_g_tol, options.loss_function_jit)
-    fn = _CACHE.get(key)
+    fn = _memo.get(key)
     if fn is None:
         fn = _build_const_opt(options, n_slots)
-        _CACHE[key] = fn
+        _memo[key] = fn
     return fn

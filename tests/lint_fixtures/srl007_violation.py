@@ -3,7 +3,7 @@ Options field the cached body reads. A second search with a different
 ``loss_function_jit`` silently reuses the first search's compiled const-opt
 objective."""
 
-_CACHE = {}
+_memo = {}
 
 
 def _build_const_opt(options, n_slots):
@@ -15,8 +15,8 @@ def _build_const_opt(options, n_slots):
 
 def get_const_opt_fn(options, n_slots):
     key = (n_slots, options.optimizer_g_tol)  # EXPECT: SRL007
-    fn = _CACHE.get(key)
+    fn = _memo.get(key)
     if fn is None:
         fn = _build_const_opt(options, n_slots)
-        _CACHE[key] = fn
+        _memo[key] = fn
     return fn

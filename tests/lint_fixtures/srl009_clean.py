@@ -1,0 +1,23 @@
+"""SRL009 clean twin: caching through the unified ProgramCache API, plus
+the read-only and non-cache dict uses the rule must NOT flag."""
+
+from symbolicregression_jl_tpu.serve.program_cache import global_program_cache
+
+PROGRAM_CACHE = global_program_cache()  # not a dict literal: API object
+
+_FEATURE_TABLE = {}  # ALL-CAPS dict, but not a *CACHE* name
+_LOOKUP_CACHE: dict = {"seed": 0}  # cache dict, but only ever READ below
+
+
+def make_score_fn(fn_key, build):
+    fn = PROGRAM_CACHE.get("score_fn", fn_key)
+    if fn is None:
+        fn = PROGRAM_CACHE.put("score_fn", fn_key, build())
+    return fn
+
+
+def lookup(key):
+    _FEATURE_TABLE[key] = key  # mutation of a non-cache dict is fine
+    if key in _LOOKUP_CACHE:  # membership test: a read
+        return _LOOKUP_CACHE[key]  # subscript load: a read
+    return _LOOKUP_CACHE.get(key)  # .get(): a read

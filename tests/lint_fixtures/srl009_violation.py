@@ -1,0 +1,29 @@
+"""SRL009 violation: direct mutation of module-level program-cache dicts.
+
+The pre-r12 pattern: each compile site grows its own ALL-CAPS module dict
+with a copy-pasted evict-then-insert block — no shared lock, no byte budget,
+no counters. All caching must go through serve.program_cache.ProgramCache.
+"""
+
+_SCORE_FN_CACHE: dict = {}
+_AOT_CACHE = dict()
+
+
+def make_score_fn(fn_key, build):
+    hit = _SCORE_FN_CACHE.get(fn_key)  # reads are fine
+    if hit is not None:
+        return hit
+    fn = build()
+    if len(_SCORE_FN_CACHE) >= 12:
+        _SCORE_FN_CACHE.pop(next(iter(_SCORE_FN_CACHE)))  # EXPECT: SRL009
+    _SCORE_FN_CACHE[fn_key] = fn  # EXPECT: SRL009
+    return fn
+
+
+def drop_compiled(key):
+    del _AOT_CACHE[key]  # EXPECT: SRL009
+    _AOT_CACHE.clear()  # EXPECT: SRL009
+
+
+def adopt(key, exe):
+    return _AOT_CACHE.setdefault(key, exe)  # EXPECT: SRL009

@@ -84,29 +84,40 @@ def test_split_path_still_counts_stages(monkeypatch):
     assert "fused_iter" not in calls
 
 
-def test_cache_get_lru():
-    """_cache_get_lru refreshes hits to the MRU slot, so the insert-side
-    eviction (pop the FIRST key) removes the least-recently-USED entry,
-    not the oldest insert."""
-    cache = {"a": 1, "b": 2, "c": 3}
-    assert ds._cache_get_lru(cache, "a") == 1
-    assert list(cache) == ["b", "c", "a"]  # hit moved to the back
-    assert ds._cache_get_lru(cache, "zz") is None  # miss: order untouched
-    assert list(cache) == ["b", "c", "a"]
-    cache.pop(next(iter(cache)))  # the insert-side eviction step
-    assert "a" in cache and "b" not in cache
+def test_program_cache_hit_refreshes_lru_order():
+    """A hit moves the entry to the MRU slot, so capacity eviction removes
+    the least-recently-USED program, not the oldest insert (the guarantee
+    the old _cache_get_lru helper provided, now inside ProgramCache)."""
+    from symbolicregression_jl_tpu.serve.program_cache import ProgramCache
+
+    cache = ProgramCache(capacity=3)
+    for k, v in (("a", 1), ("b", 2), ("c", 3)):
+        cache.put("score_fn", k, v)
+    assert cache.get("score_fn", "a") == 1  # refresh "a" to MRU
+    assert cache.get("score_fn", "zz") is None  # miss: order untouched
+    cache.put("score_fn", "d", 4)  # over capacity -> evict LRU
+    assert cache.get("score_fn", "a") == 1
+    assert cache.get("score_fn", "b") is None  # "b" was LRU, evicted
+    assert cache.stats()["evictions"] == 1
 
 
-def test_score_fn_cache_evicts_least_recently_used(monkeypatch):
-    """At the 12-entry cap, touching the oldest-inserted entry through the
-    production lookup keeps it alive past the next eviction."""
-    fake = {f"k{i}": i for i in range(12)}
-    monkeypatch.setattr(ds, "_SCORE_FN_CACHE", fake)
-    with ds._CACHE_LOCK:
-        assert ds._cache_get_lru(ds._SCORE_FN_CACHE, "k0") == 0
-    # mirror of the insert path in _make_score_fn: evict-first, then insert
-    if len(ds._SCORE_FN_CACHE) >= 12:
-        ds._SCORE_FN_CACHE.pop(next(iter(ds._SCORE_FN_CACHE)))
-    ds._SCORE_FN_CACHE["new"] = object()
-    assert "k0" in ds._SCORE_FN_CACHE
-    assert "k1" not in ds._SCORE_FN_CACHE
+def test_device_search_uses_unified_program_cache():
+    """device_search routes every compiled-program lookup through the one
+    global ProgramCache (the module dicts _SCORE_FN_CACHE/_AOT_CACHE are
+    gone), and eviction at the cap keeps a just-touched entry alive."""
+    from symbolicregression_jl_tpu.serve.program_cache import (
+        ProgramCache,
+        global_program_cache,
+    )
+
+    assert ds.PROGRAM_CACHE is global_program_cache()
+    for stale in ("_SCORE_FN_CACHE", "_SCORE_DATA_CACHE", "_AOT_CACHE"):
+        assert not hasattr(ds, stale)
+
+    cache = ProgramCache(capacity=12)
+    for i in range(12):
+        cache.put("score_fn", f"k{i}", i)
+    assert cache.get("score_fn", "k0") == 0  # touch the oldest insert
+    cache.put("score_fn", "new", object())  # at cap: evicts LRU = k1
+    assert cache.get("score_fn", "k0") == 0
+    assert cache.get("score_fn", "k1") is None

@@ -1,0 +1,256 @@
+"""Unified program cache (serve/program_cache.py) — unit + engine tests.
+
+The engine-level tests reuse the exact problem/options bucket from
+test_device_search.py so the compiled programs are shared across the whole
+pytest process (test file order warms the bucket before we measure hits).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.models import device_search as ds
+from symbolicregression_jl_tpu.serve.program_cache import (
+    ProgramCache,
+    global_program_cache,
+)
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+# -- unit: LRU, budgets, counters ---------------------------------------------
+
+
+def test_put_has_setdefault_semantics():
+    cache = ProgramCache(capacity=4)
+    first = object()
+    second = object()
+    assert cache.put("score_fn", "k", first) is first
+    # the build-race loser adopts the winner's value
+    assert cache.put("score_fn", "k", second) is first
+    assert cache.get("score_fn", "k") is first
+    assert len(cache) == 1
+
+
+def test_data_entries_bounded_by_bytes_not_count():
+    cache = ProgramCache(capacity=2, data_budget_bytes=100)
+    # many small datasets fit simultaneously (old cap-12 design would not
+    # have cared, but the converse mattered: small MUST NOT evict large)
+    for i in range(5):
+        cache.put("score_data", f"small{i}", i, nbytes=10)
+    assert len(cache.keys("score_data")) == 5
+    # one large dataset evicts smalls until the budget fits
+    cache.put("score_data", "large", "L", nbytes=80)
+    assert cache.stats()["data_bytes"] <= 100
+    assert cache.get("score_data", "large") == "L"
+    # programs were never displaced by data churn
+    cache.put("score_fn", "p1", 1)
+    cache.put("score_fn", "p2", 2)
+    cache.put("score_data", "huge", "H", nbytes=100)
+    assert cache.get("score_fn", "p1") == 1
+    assert cache.get("score_fn", "p2") == 2
+
+
+def test_oversized_data_entry_admitted_alone():
+    cache = ProgramCache(capacity=2, data_budget_bytes=50)
+    cache.put("score_data", "a", "a", nbytes=30)
+    cache.put("score_data", "big", "B", nbytes=500)  # > whole budget
+    # never rejected: the just-inserted entry is exempt from eviction
+    assert cache.get("score_data", "big") == "B"
+    assert cache.get("score_data", "a") is None  # evicted to make room
+
+
+def test_counters_and_stats_shape():
+    cache = ProgramCache(capacity=2)
+    cache.get("aot", "missing")
+    cache.put("aot", "k", 1)
+    cache.get("aot", "k")
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1 and st["evictions"] == 0
+    assert st["hit_ratio"] == 0.5
+    assert st["by_kind"]["aot"]["hits"] == 1
+    cache.clear()
+    st = cache.stats()
+    assert st["hits"] == st["misses"] == st["entries"] == 0
+
+
+def test_env_capacity_knob(monkeypatch):
+    monkeypatch.setenv("SR_PROGRAM_CACHE_SIZE", "3")
+    monkeypatch.setenv("SR_SCORE_DATA_CACHE_MB", "1")
+    cache = ProgramCache()
+    assert cache.capacity == 3
+    assert cache.data_budget_bytes == 1 << 20
+
+
+def test_thread_hammer_converges_on_one_value():
+    """Concurrent builders for the same key all converge on the canonical
+    value, and the cache never exceeds its capacity under churn."""
+    cache = ProgramCache(capacity=8)
+    built = []
+    results = []
+    lock = threading.Lock()
+
+    def build(key):
+        time.sleep(0.005)  # widen the race window
+        obj = object()
+        with lock:
+            built.append(obj)
+        return obj
+
+    def worker(i):
+        for j in range(20):
+            # 6 resident keys (threads race on them, hits accrue) plus a
+            # per-thread churn key that forces concurrent evictions
+            key = f"churn{i}-{j}" if j % 7 == 6 else f"k{j % 6}"
+            v = cache.get_or_build("aot", key, lambda key=key: build(key))
+            with lock:
+                results.append((key, v))
+            assert len(cache) <= 8
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # within any window where a key stayed resident, every thread that hit it
+    # got the identical object; and the cache respected its bound throughout
+    assert len(cache) <= 8
+    st = cache.stats()
+    assert st["hits"] + st["misses"] == len(results)
+    # setdefault semantics: at least some concurrent builds were discarded in
+    # favour of the winner (hits exist despite constant churn)
+    assert st["hits"] > 0
+
+
+# -- unit: serve-level digests -------------------------------------------------
+
+
+def test_options_digest_separates_configs():
+    from symbolicregression_jl_tpu.serve.queue import options_digest, shape_bucket
+
+    d1 = options_digest(_opts())
+    assert d1 == options_digest(_opts())  # deterministic
+    assert d1 != options_digest(_opts(maxsize=12))
+    assert d1 != options_digest(_opts(binary_operators=["+", "-"]))
+    X, y = _problem()
+    X2, y2 = _problem(n=96)
+    b1 = shape_bucket(X, y, None, _opts())
+    assert b1 == shape_bucket(X, y, None, _opts())
+    assert b1 != shape_bucket(X2, y2, None, _opts())
+    assert b1 != shape_bucket(X, y, np.ones_like(y), _opts())
+
+
+# -- engine: the global cache is the only program store ------------------------
+
+
+def test_warm_search_hits_cache_and_profiles_counters():
+    """A repeat same-bucket search is all hits (zero misses), and the
+    per-search counter DELTA surfaces in SearchResult.engine_profile."""
+    X, y = _problem()
+    # warm up with the SAME options (profile gates one readback variant, so
+    # a profile=False warm-up would leave exactly one program cold)
+    equation_search(X, y, options=_opts(profile=True), niterations=1, verbosity=0)
+    res = equation_search(
+        X, y, options=_opts(profile=True), niterations=1, verbosity=0
+    )
+    pc = res.engine_profile["counters"]["program_cache"]
+    assert pc["hits"] > 0
+    assert pc["misses"] == 0  # fully warm: nothing recompiled
+    assert pc["entries"] >= 1
+
+
+def test_two_threads_same_shape_share_executables():
+    """Two threads driving same-bucket searches share the compiled programs:
+    both runs are pure cache hits and agree with the sequential result."""
+    X, y = _problem()
+    ref = equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    cache = global_program_cache()
+    before = cache.stats()
+    out = [None, None]
+    errs = []
+
+    def run(i):
+        try:
+            out[i] = equation_search(
+                X, y, options=_opts(), niterations=1, verbosity=0
+            )
+        except BaseException as e:  # surfaced below; a bare thread would hide it
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    after = cache.stats()
+    assert after["hits"] - before["hits"] >= 2
+    assert after["misses"] == before["misses"]  # no thread recompiled
+    assert after["entries"] == before["entries"]  # no duplicate executables
+    for res in out:
+        assert res.best().loss == ref.best().loss
+
+
+def test_different_options_digest_never_collides():
+    """A search with a different Options digest compiles its own programs —
+    it must never be handed another config's executable, and must not evict
+    the hot bucket's entries while capacity allows."""
+    X, y = _problem()
+    cache = global_program_cache()
+    equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    before = cache.stats()
+    keys_before = set(cache.keys())
+    res = equation_search(
+        X, y, options=_opts(binary_operators=["+", "-"]), niterations=1, verbosity=0
+    )
+    assert min(m.loss for m in res.pareto_frontier) < 10.0  # sane search
+    after = cache.stats()
+    keys_after = set(cache.keys())
+    assert after["misses"] > before["misses"]  # new config compiled fresh
+    assert keys_before < keys_after  # old keys intact, new keys added
+    # the hot bucket is STILL warm after the foreign config ran
+    res2 = equation_search(
+        X, y, options=_opts(profile=True), niterations=1, verbosity=0
+    )
+    pc = res2.engine_profile["counters"]["program_cache"]
+    assert pc["misses"] == 0
+
+
+def test_eviction_mid_search_recompiles_not_errors(monkeypatch):
+    """With a 1-entry cache every put evicts the previous program while the
+    search is still running — the search must complete from its held
+    references, and the next search simply recompiles."""
+    small = ProgramCache(capacity=1, data_budget_bytes=1 << 30)
+    monkeypatch.setattr(ds, "PROGRAM_CACHE", small)
+    X, y = _problem()
+    r1 = equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    st = small.stats()
+    assert st["evictions"] > 0  # entries churned out mid-search
+    assert len(small) <= 1 + len(small.keys("score_data"))
+    # rerun: everything misses (was evicted) -> recompile, not error
+    r2 = equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    assert r1.best().loss == r2.best().loss
+    assert small.stats()["misses"] > st["misses"]

@@ -1,0 +1,197 @@
+"""Multi-tenant search server (serve/) — queue unit tests + daemon tests.
+
+Daemon tests reuse the canonical tiny problem/options bucket from
+test_device_search.py, so in a full suite run the compiled programs are
+already resident and every job here runs warm.
+"""
+
+import time
+
+import numpy as np
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.serve import (
+    CANCELLED,
+    DONE,
+    EXPIRED,
+    Job,
+    JobQueue,
+    JobSpec,
+    SearchServer,
+)
+from symbolicregression_jl_tpu.utils.checkpoint import load_frontier_bytes
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _spec(X, y, **kw):
+    kw.setdefault("options", _opts())
+    kw.setdefault("niterations", 1)
+    return JobSpec(X, y, **kw)
+
+
+# -- queue unit tests (no engine, no jax dispatch) -----------------------------
+
+
+def _mkjob(seq, **kw):
+    X, y = _problem(n=20)
+    return Job(f"j{seq}", _spec(X, y, **kw), seq=seq)
+
+
+def test_admission_priority_then_warmth_then_fifo():
+    q = JobQueue(default_quota=4)
+    lo = _mkjob(1, priority=0)
+    hi = _mkjob(2, priority=5)
+    lo2 = _mkjob(3, priority=0)
+    for j in (lo, hi, lo2):
+        q.submit(j)
+    # priority first
+    assert q.acquire(timeout=0) is hi
+    # FIFO within a priority
+    assert q.acquire(timeout=0) is lo
+    assert q.acquire(timeout=0) is lo2
+    assert q.acquire(timeout=0) is None
+
+
+def test_admission_prefers_warm_bucket_within_priority():
+    q = JobQueue(default_quota=4)
+    cold = _mkjob(1)  # submitted first...
+    warm = Job("jw", _spec(*_problem(n=24)), seq=2)
+    q.submit(cold)
+    q.submit(warm)
+    # ...but the warm-bucket job is admitted first at equal priority
+    got = q.acquire(warm_buckets={warm.bucket}, timeout=0)
+    assert got is warm
+    assert q.acquire(warm_buckets={warm.bucket}, timeout=0) is cold
+
+
+def test_tenant_quota_bounds_concurrent_running():
+    q = JobQueue(default_quota=1, quotas={"big": 2})
+    a1 = _mkjob(1, tenant="a")
+    a2 = _mkjob(2, tenant="a")
+    b1 = _mkjob(3, tenant="big")
+    b2 = _mkjob(4, tenant="big")
+    for j in (a1, a2, b1, b2):
+        q.submit(j)
+    assert q.acquire(timeout=0) is a1
+    # tenant "a" is at quota: its next job is skipped, "big" admits two
+    assert q.acquire(timeout=0) is b1
+    assert q.acquire(timeout=0) is b2
+    assert q.acquire(timeout=0) is None
+    q.release(a1)
+    assert q.acquire(timeout=0) is a2
+
+
+def test_take_expired_and_drain():
+    q = JobQueue()
+    expired = _mkjob(1, deadline_seconds=0.001)
+    live = _mkjob(2)
+    cancelled = _mkjob(3)
+    cancelled.cancel_requested.set()
+    for j in (expired, live, cancelled):
+        q.submit(j)
+    time.sleep(0.01)
+    out = q.take_expired()
+    assert set(out) == {expired, cancelled}
+    assert len(q) == 1
+    assert q.drain() == [live]
+    assert len(q) == 0
+
+
+# -- daemon tests --------------------------------------------------------------
+
+
+def test_jobs_run_stream_and_finish(tmp_path):
+    X, y = _problem()
+    with SearchServer(max_concurrency=2, spool_dir=str(tmp_path)) as srv:
+        ids = [
+            srv.submit(_spec(X, y, tenant="acme", niterations=2, label="a")),
+            srv.submit(_spec(X, y, tenant="acme", niterations=2, label="b")),
+            srv.submit(_spec(X, y, tenant="zeta", niterations=2, label="c")),
+        ]
+        jobs = [srv.wait(i, timeout=600) for i in ids]
+        for job in jobs:
+            assert job.state == DONE, job.summary()
+            assert job.ttff is not None and job.ttff > 0
+            frames = srv.frames(job.id)
+            # stream_every=1 over 2 iterations, plus the definitive final frame
+            assert len(frames) >= 2
+            upd = load_frontier_bytes(frames[-1])
+            assert upd.iteration == 2 and upd.niterations == 2
+            assert len(upd.members) >= 1
+            assert min(m.loss for m in upd.members) < 10.0
+        st = srv.stats()
+        assert st["jobs"][DONE] == 3
+        assert st["program_cache"]["hits"] > 0
+        assert 0.0 <= st["warm_hit_ratio"] <= 1.0
+
+
+def test_deadline_expires_while_queued(tmp_path):
+    X, y = _problem()
+    with SearchServer(max_concurrency=1, spool_dir=str(tmp_path)) as srv:
+        blocker = srv.submit(_spec(X, y, niterations=2))
+        doomed = srv.submit(_spec(X, y, deadline_seconds=0.05))
+        job = srv.wait(doomed, timeout=600)
+        assert job.state == EXPIRED
+        assert job.started_at is None  # never ran: expired in the queue
+        assert srv.wait(blocker, timeout=600).state == DONE
+
+
+def test_cancel_queued_job(tmp_path):
+    X, y = _problem()
+    with SearchServer(max_concurrency=1, spool_dir=str(tmp_path)) as srv:
+        blocker = srv.submit(_spec(X, y, niterations=2))
+        victim = srv.submit(_spec(X, y))
+        srv.cancel(victim)
+        job = srv.wait(victim, timeout=600)
+        assert job.state == CANCELLED
+        assert job.started_at is None
+        assert srv.wait(blocker, timeout=600).state == DONE
+
+
+def test_preemption_checkpoints_and_resumes(tmp_path):
+    X, y = _problem()
+    with SearchServer(max_concurrency=1, spool_dir=str(tmp_path)) as srv:
+        low = srv.submit(
+            _spec(X, y, niterations=4, priority=0, label="low", tenant="bulk")
+        )
+        # wait until the low-priority job is mid-run (first frame streamed)
+        deadline = time.monotonic() + 600
+        while not srv.frames(low) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert srv.frames(low), "low job never produced a frame"
+        high = srv.submit(
+            _spec(X, y, niterations=1, priority=5, label="high", tenant="vip")
+        )
+        hj = srv.wait(high, timeout=600)
+        assert hj.state == DONE
+        lj = srv.wait(low, timeout=600)
+        assert lj.state == DONE, lj.summary()
+        assert lj.preemptions == 1
+        assert lj.resume_path is not None  # resumed from a spool checkpoint
+        assert lj.iterations_done == 4  # finished its FULL budget post-resume
+        last = load_frontier_bytes(srv.frames(low)[-1])
+        assert last.iteration == 4 and last.niterations == 4
+        # the high-priority job ran before the low job's resumed tail
+        assert hj.finished_at <= lj.finished_at
