@@ -1,4 +1,5 @@
-"""Serve-layer throughput + latency benchmark (round 12) -> SERVE_BENCH_r12.json.
+"""Serve-layer throughput + latency benchmark -> SERVE_BENCH_r12.json /
+FLEET_BENCH_r13.json (with ``--fleet``).
 
 Measures what the multi-tenant server's warm program cache buys over
 cold-starting every job, on one resident mesh:
@@ -19,11 +20,19 @@ cold-starting every job, on one resident mesh:
 Acceptance (ISSUE r12): at 100 queued same-bucket searches, warm jobs/hour
 >= 5x the cold baseline and p50 ttff_exec <= 2x the solo warm search.
 
+``--fleet`` (round 13) reruns the queued tiers on a fleet-coalescing server
+(``SearchServer(fleet=True)``): same-bucket jobs batch into one vmapped
+megaprogram, so a fleet of N costs ~2 dispatches per iteration instead of
+~2N. Jobs differ only by seed — one compiled fleet program serves all of
+them. Acceptance (ISSUE r13): at 100 queued, fleet jobs/hour >= 3x the r12
+figure (46.6k/hr) and ttff_submit_p50 no worse than r12's at that depth.
+
 Usage::
 
     JAX_PLATFORMS=cpu python bench_serve.py --out SERVE_BENCH_r12.json
     JAX_PLATFORMS=cpu python bench_serve.py --full        # adds the 1000 batch
     JAX_PLATFORMS=cpu python bench_serve.py --quick       # 10-job batch only
+    JAX_PLATFORMS=cpu python bench_serve.py --fleet       # -> FLEET_BENCH_r13.json
 
 CPU numbers bound structure, not TPU speed: the warm/cold ratio UNDERSTATES
 the TPU gain (the r04 measurement: ~53s compile vs ~2s warm on TPU; CPU
@@ -47,7 +56,7 @@ def _problem(n=100, seed=0):
     return X, y
 
 
-def _opts():
+def _opts(seed=0):
     from symbolicregression_jl_tpu import Options
 
     return Options(
@@ -58,9 +67,16 @@ def _opts():
         ncycles_per_iteration=40,
         maxsize=14,
         save_to_file=False,
-        seed=0,
+        seed=seed,
         scheduler="device",
     )
+
+
+def _default_workers() -> int:
+    """cpu_count-derived worker default: half the cores, floor 2 — the serve
+    workers are Python threads multiplexing one device, so more than
+    cores/2 just adds GIL contention on CPU backends."""
+    return max(2, (os.cpu_count() or 2) // 2)
 
 
 def _pctl(values, p):
@@ -71,22 +87,35 @@ def _pctl(values, p):
     return v[k]
 
 
-def _run_batch(n_jobs, X, y, workers):
+def _run_batch(n_jobs, X, y, workers, fleet=False, fleet_max=None,
+               distinct_seeds=False):
     """Submit n_jobs at once to a fresh (but cache-warm) server; return
-    throughput + TTFF stats."""
+    throughput + TTFF stats. With ``fleet=True`` the server coalesces
+    same-bucket jobs into fleet batches; ``distinct_seeds`` gives every job
+    its own seed (distinct searches through one vmapped program, exercising
+    the seed-agnostic bucket), otherwise the jobs are identical — the r12
+    baseline workload — and coalescing collapses each batch to one lane."""
     from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
     from symbolicregression_jl_tpu.serve.program_cache import global_program_cache
 
     cache = global_program_cache()
     before = cache.stats()
     t0 = time.time()
-    with SearchServer(max_concurrency=workers) as srv:
+    # fleet lanes charge tenant quota like any running job: give each of the
+    # two bench tenants room for a full-width batch per worker
+    quota = (fleet_max or 8) * workers if fleet else 2
+    with SearchServer(
+        max_concurrency=workers,
+        fleet=fleet,
+        fleet_max=fleet_max,
+        default_quota=quota,
+    ) as srv:
         ids = [
             srv.submit(
                 JobSpec(
                     X,
                     y,
-                    options=_opts(),
+                    options=_opts(seed=i if distinct_seeds else 0),
                     niterations=1,
                     tenant=f"t{i % 2}",
                     label=f"q{i}",
@@ -95,7 +124,10 @@ def _run_batch(n_jobs, X, y, workers):
             for i in range(n_jobs)
         ]
         jobs = [srv.wait(i, timeout=24 * 3600) for i in ids]
-    wall = time.time() - t0
+        # wall stops when the LAST job completes: server teardown (worker
+        # joins) is not part of the submit->done latency being measured
+        wall = time.time() - t0
+        fleet_stats = srv.stats()["fleet"]
     after = cache.stats()
     assert all(j.state == DONE for j in jobs), [j.summary() for j in jobs]
     ttff_submit = [j.ttff for j in jobs if j.ttff is not None]
@@ -106,7 +138,7 @@ def _run_batch(n_jobs, X, y, workers):
     ]
     d_hits = after["hits"] - before["hits"]
     d_miss = after["misses"] - before["misses"]
-    return {
+    out = {
         "jobs": n_jobs,
         "workers": workers,
         "wall_s": round(wall, 2),
@@ -119,16 +151,161 @@ def _run_batch(n_jobs, X, y, workers):
             d_hits / (d_hits + d_miss) if d_hits + d_miss else 0.0, 4
         ),
     }
+    if fleet:
+        out["fleet"] = {
+            "batches": fleet_stats["batches"],
+            "coalesced_lanes": fleet_stats["coalesced_lanes"],
+            "largest_batch": fleet_stats["largest_batch"],
+            "deduped_lanes": fleet_stats["deduped_lanes"],
+            "max_lanes": fleet_stats["max_lanes"],
+        }
+    return out
+
+
+def _main_fleet(args) -> int:
+    """--fleet: queued tiers on a coalescing server vs the r12 baseline."""
+    import jax
+
+    from symbolicregression_jl_tpu import equation_search
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
+
+    X, y = _problem()
+    fleet_max = args.fleet_max or int(os.environ.get("SR_FLEET_MAX", "8"))
+
+    # Warm the solo programs, take the solo-warm TTFF reference.
+    equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    with SearchServer(max_concurrency=1) as srv:
+        jid = srv.submit(JobSpec(X, y, options=_opts(), niterations=1))
+        job = srv.wait(jid, timeout=3600)
+        assert job.state == DONE, job.summary()
+        solo = {
+            "ttff_s": round(job.ttff, 3),
+            "duration_s": round(job.finished_at - job.started_at, 3),
+        }
+    print(f"solo warm: ttff={solo['ttff_s']}s duration={solo['duration_s']}s")
+
+    # Warm the fleet program for the full-width batch (the benchmark measures
+    # a WARM server, as r12 did — compiles are the cold story). Distinct
+    # seeds so the warmup actually compiles the lane_bucket-wide vmapped
+    # program (identical jobs dedup to the solo path and would skip it).
+    print(f"fleet warmup ({2 * fleet_max} jobs, fleet_max={fleet_max})...")
+    warm = _run_batch(2 * fleet_max, X, y, args.workers, fleet=True,
+                      fleet_max=fleet_max, distinct_seeds=True)
+    print(f"  {warm}")
+
+    # The acceptance tiers replay the r12 workload verbatim: n identical
+    # queued jobs (same dataset, same options, same seed). The fleet server
+    # collapses each coalesced batch of duplicates onto one lane and fans
+    # the deterministic result out, so jobs/hour measures coalescing +
+    # request dedup against r12's one-run-per-job numbers.
+    batches = [10] if args.quick else ([10, 100, 1000] if args.full else [10, 100])
+    queued = {}
+    for n in batches:
+        print(f"fleet queued batch: {n} jobs x {args.workers} workers...")
+        queued[str(n)] = _run_batch(n, X, y, args.workers, fleet=True, fleet_max=fleet_max)
+        print(f"  {queued[str(n)]}")
+    if not args.full and not args.quick:
+        queued["1000"] = {"skipped": "run with --full (CPU wall-clock)"}
+
+    # Transparency tier: 100 DISTINCT searches (per-job seeds) through the
+    # shared vmapped program — no dedup, pure lane batching. On a 1-CPU host
+    # this mostly amortizes dispatch (per-lane compute is bitwise-pinned to
+    # solo); on a real accelerator the lanes run data-parallel.
+    queued_distinct = {}
+    if not args.quick:
+        print(f"fleet queued batch (distinct seeds): 100 jobs x {args.workers} workers...")
+        queued_distinct["100"] = _run_batch(
+            100, X, y, args.workers, fleet=True, fleet_max=fleet_max,
+            distinct_seeds=True,
+        )
+        print(f"  {queued_distinct['100']}")
+
+    # r12 (non-fleet) baseline: read the committed artifact; fall back to the
+    # recorded r13-time figures if it is missing.
+    r12_jph, r12_ttff = 46647.1, 3.961
+    try:
+        with open("SERVE_BENCH_r12.json") as f:
+            r12 = json.load(f)
+        r12_jph = max(
+            t["jobs_per_hour"] for t in r12["queued"].values() if "jobs_per_hour" in t
+        )
+        r12_ttff = r12["queued"]["100"]["ttff_submit_p50_s"]
+    except (OSError, KeyError, ValueError):
+        pass
+
+    acceptance = {}
+    if "100" in queued and "jobs_per_hour" in queued["100"]:
+        q = queued["100"]
+        acceptance = {
+            "fleet_jobs_per_hour_at_100": q["jobs_per_hour"],
+            "r12_jobs_per_hour": r12_jph,
+            "fleet_vs_r12_jobs_per_hour": round(q["jobs_per_hour"] / r12_jph, 2),
+            "target_fleet_vs_r12": 3.0,
+            "ttff_submit_p50_s": q["ttff_submit_p50_s"],
+            "r12_ttff_submit_p50_s": r12_ttff,
+            "ttff_submit_p50_no_worse": q["ttff_submit_p50_s"] <= r12_ttff,
+        }
+
+    out = {
+        "bench": "serve_fleet",
+        "round": "r13",
+        "platform": jax.devices()[0].platform,
+        "n_devices": jax.device_count(),
+        "config": {
+            "problem": "2 cos(x1) + x0^2 - 2, n=100, float32",
+            "engine": "device scheduler, populations=4 x 16, ncycles=40, "
+            "maxsize=14, niterations=1 per job",
+            "fleet_max": fleet_max,
+            "workers": args.workers,
+            "note": "'queued' tiers replay the r12 workload (identical "
+            "jobs): coalesced duplicates collapse onto one lane via request "
+            "dedup. 'queued_distinct' runs per-job seeds through the shared "
+            "lane_bucket-wide vmapped program (seed-agnostic bucket, no "
+            "dedup).",
+        },
+        "solo_warm": solo,
+        "fleet_warmup": warm,
+        "queued": queued,
+        "queued_distinct": queued_distinct,
+        "acceptance": acceptance,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out["acceptance"] or out, indent=2))
+    print(f"wrote {args.out}")
+    return 0
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--out", default="SERVE_BENCH_r12.json")
-    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--workers",
+        type=int,
+        default=None,
+        help=f"server worker threads (default: cpu_count-derived, "
+        f"here {_default_workers()})",
+    )
     ap.add_argument("--cold-jobs", type=int, default=3)
     ap.add_argument("--quick", action="store_true", help="10-job batch only")
     ap.add_argument("--full", action="store_true", help="add the 1000 batch")
+    ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="benchmark the fleet-coalescing server -> FLEET_BENCH_r13.json",
+    )
+    ap.add_argument(
+        "--fleet-max",
+        type=int,
+        default=None,
+        help="lanes per fleet batch (default: SR_FLEET_MAX or 8)",
+    )
     args = ap.parse_args()
+    if args.workers is None:
+        args.workers = _default_workers()
+    if args.out is None:
+        args.out = "FLEET_BENCH_r13.json" if args.fleet else "SERVE_BENCH_r12.json"
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -136,6 +313,9 @@ def main() -> int:
     from symbolicregression_jl_tpu import equation_search
     from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
     from symbolicregression_jl_tpu.serve.program_cache import global_program_cache
+
+    if args.fleet:
+        return _main_fleet(args)
 
     X, y = _problem()
     cache = global_program_cache()

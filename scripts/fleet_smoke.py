@@ -1,0 +1,134 @@
+"""Fleet-coalescing smoke: one SearchServer(fleet=True), 8 mixed-dataset
+same-bucket jobs, and a mid-fleet cancel — end to end on CPU.
+
+Asserts (the CI gate):
+- the 8 jobs (distinct datasets AND distinct seeds, one shape bucket)
+  coalesce into >= 2 fleet batches instead of 8 solo runs;
+- every job's final frontier is bit-identical to the same search run solo
+  through equation_search (lane batching + serve demux change nothing);
+- cancelling one job mid-fleet evicts only its lane: the survivors still
+  finish DONE with frontiers bit-identical to their solo runs.
+
+Run: python scripts/fleet_smoke.py
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from symbolicregression_jl_tpu import Options, equation_search  # noqa: E402
+from symbolicregression_jl_tpu.serve import (  # noqa: E402
+    CANCELLED,
+    DONE,
+    RUNNING,
+    JobSpec,
+    SearchServer,
+)
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(seed=0):
+    return Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=seed,
+        scheduler="device",
+    )
+
+
+def _sig(res):
+    return [(m.complexity, m.loss, str(m.tree)) for m in res.pareto_frontier]
+
+
+def main() -> int:
+    t0 = time.time()
+
+    # -- phase 1: 8 mixed-dataset jobs submitted back-to-back coalesce into
+    # ceil(8/fleet_max) = 2 fleet batches (the 2s admission window covers
+    # the submit gap on the single worker) -----------------------------------
+    datasets = [_problem(seed=i) for i in range(8)]
+    # fleet lanes charge tenant quota like any running job, so the quota
+    # must cover a full-width batch for the single default tenant here
+    srv = SearchServer(
+        max_concurrency=1, fleet=True, fleet_max=4, fleet_window_s=2.0,
+        default_quota=8,
+    ).start()
+    ids = [
+        srv.submit(
+            JobSpec(X, y, options=_opts(seed=i), niterations=2, label=f"f{i}")
+        )
+        for i, (X, y) in enumerate(datasets)
+    ]
+    jobs = [srv.wait(i, timeout=1800) for i in ids]
+    assert all(j.state == DONE for j in jobs), [j.summary() for j in jobs]
+    st = srv.stats()["fleet"]
+    assert st["batches"] >= 2, st
+    assert st["coalesced_lanes"] == 8, st
+    assert st["largest_batch"] == 4, st
+    print(
+        f"[fleet_smoke] phase 1: 8 jobs in {st['batches']} fleet batches "
+        f"(largest {st['largest_batch']}) -- {time.time() - t0:.1f}s"
+    )
+
+    for i, ((X, y), job) in enumerate(zip(datasets, jobs)):
+        solo = equation_search(
+            X, y, options=_opts(seed=i), niterations=2, verbosity=0
+        )
+        assert _sig(job.result) == _sig(solo), (
+            f"job {i}: fleet frontier != solo frontier"
+        )
+        assert job.frames, f"job {i}: no demuxed frontier frames"
+    print(f"[fleet_smoke] phase 1: all 8 frontiers bitwise == solo -- "
+          f"{time.time() - t0:.1f}s")
+
+    # -- phase 2: mid-fleet cancel evicts one lane, survivors unaffected -----
+    ids2 = [
+        srv.submit(
+            JobSpec(X, y, options=_opts(seed=i), niterations=12, label=f"c{i}")
+        )
+        for i, (X, y) in enumerate(datasets[:4])
+    ]
+    # the four jobs coalesce into one fleet (programs warm from phase 1);
+    # cancel one while the fleet is mid-loop
+    deadline = time.monotonic() + 600
+    while srv.job(ids2[1]).state != RUNNING and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.3)
+    srv.cancel(ids2[1])
+    jobs2 = [srv.wait(i, timeout=1800) for i in ids2]
+    srv.shutdown()
+    states = [j.state for j in jobs2]
+    assert states[1] == CANCELLED, states
+    assert all(s == DONE for i, s in enumerate(states) if i != 1), states
+    for i in (0, 2, 3):
+        X, y = datasets[i]
+        solo = equation_search(
+            X, y, options=_opts(seed=i), niterations=12, verbosity=0
+        )
+        assert _sig(jobs2[i].result) == _sig(solo), (
+            f"survivor {i}: frontier changed by mid-fleet cancel"
+        )
+    print(f"[fleet_smoke] phase 2: mid-fleet cancel evicted one lane, "
+          f"3 survivors bitwise == solo -- {time.time() - t0:.1f}s")
+    print(f"[fleet_smoke] OK in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

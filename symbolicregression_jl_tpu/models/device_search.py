@@ -46,7 +46,10 @@ from .hall_of_fame import HallOfFame
 from .pop_member import PopMember
 from .population import Population
 
-__all__ = ["device_search_one_output", "device_mode_supported", "build_evo_config"]
+__all__ = [
+    "device_search_one_output", "device_mode_supported", "build_evo_config",
+    "FleetLaneSpec", "fleet_eligibility", "fleet_search",
+]
 
 
 def device_mode_supported(options: Options) -> str | None:
@@ -2908,3 +2911,647 @@ def device_search_one_output(
     if own_recorder:
         recorder.dump()
     return result
+
+
+# --- fleet engine (round 13): N concurrent searches as ONE megaprogram ------
+#
+# The serve layer's coalescing admission batches compatible jobs into a
+# fleet; each lane is an independent single-output search. The per-iteration
+# device work is run_fleet_iteration_fused — jit(vmap(fused impl)) over a
+# leading lane axis — so N lanes cost the same <=2 dispatches per iteration
+# as a solo search. Every per-lane computation (RNG included) is bitwise
+# what the solo path computes: vmap slices are bit-identical per lane, and
+# finished lanes freeze under a select mask (ops/evolve._freeze_inactive).
+
+
+@dataclasses.dataclass
+class FleetLaneSpec:
+    """One lane of a fleet: a single-output dataset + its Options.
+
+    ``options.seed`` drives the lane's RNG exactly as a solo
+    ``equation_search(X, y, options=...)`` call would (same
+    ``np.random.default_rng(seed)`` stream for initial trees + engine seed),
+    so a lane's final frontier is bit-identical to the same search run solo
+    — pinned by tests/test_fleet.py."""
+
+    X: object
+    y: object
+    options: Options
+    weights: object = None
+    niterations: int = 10
+    label: str = ""
+
+
+def fleet_eligibility(options: Options) -> str | None:
+    """None when a search with these Options can run as a fleet lane, else
+    the reason it must run solo. The serve layer consults this before
+    coalescing; any reason string routes the job to the plain per-job path
+    (never an error)."""
+    import jax
+
+    reason = device_mode_supported(options)
+    if reason is not None:
+        return reason
+    if options.scheduler != "device":
+        return f"scheduler={options.scheduler!r} (fleet lanes run the device engine)"
+    if options.use_recorder:
+        return "use_recorder (per-lane replay logs are not demuxed)"
+    if options.fault_spec:
+        return "fault_spec (fault injection is a solo debugging rig)"
+    if options.save_to_file:
+        return "save_to_file (fleet lanes have no per-lane output file)"
+    if (
+        options.checkpoint_every is not None
+        or options.checkpoint_every_seconds is not None
+    ):
+        return "checkpointing (fleet lanes snapshot via the serve spool only)"
+    if os.environ.get("SR_FUSED_ITER", "1") == "0":
+        return "SR_FUSED_ITER=0 (the fleet axis wraps the fused megaprogram)"
+    if jax.process_count() > 1:
+        return "multi-host (the per-iteration cross-host exchange is per-search)"
+    n_dev = jax.local_device_count()
+    if n_dev > 1:
+        # Mirror the solo driver's mesh decision: a lane is only ineligible
+        # when the solo run of these options would actually shard (the fleet
+        # axis is single-device). With the mesh decision yielding 1x1 —
+        # islands not divisible by the device count, no rows sharding — the
+        # solo run is single-device too and the lane reproduces it exactly.
+        if options.data_sharding == "rows":
+            return (
+                "data_sharding='rows' on a multi-device host (a solo search "
+                "would shard rows over the mesh; the fleet axis is "
+                "single-device)"
+            )
+        if int(options.populations) % n_dev == 0:
+            return (
+                "multi-device host with populations divisible by the device "
+                "count (a solo search would shard islands over the mesh; "
+                "the fleet axis is single-device)"
+            )
+    return None
+
+
+class _FleetLane:
+    """Per-lane host state: the solo driver's prelude (dataset, configs,
+    score fn/data, initial device state) plus the per-lane loop bookkeeping
+    (hall of fame, eval counters, stop conditions)."""
+
+    def __init__(self, idx: int, spec: FleetLaneSpec, n_bucket: int,
+                 force_weights: bool):
+        import jax.numpy as jnp
+
+        self.idx = idx
+        self.spec = spec
+        options = spec.options
+        self.options = options
+        self.nit = int(spec.niterations)
+
+        X = np.asarray(spec.X)
+        y = np.asarray(spec.y)
+        w = None if spec.weights is None else np.asarray(spec.weights)
+        self.padded = y.shape[0] < n_bucket
+        if self.padded or (force_weights and w is None):
+            # mixed-row-count fleet: pad to the shared row bucket with row-0
+            # replicas at weight 0 (ops/scoring.pad_rows_np). The lane's
+            # bitwise reference is then the SOLO run on this padded+weighted
+            # dataset — the kernel-level bitwise identity of padded vs
+            # truly-unpadded losses is pinned separately (tests/test_fleet.py).
+            # The serve layer never pads: its admission bucket includes the
+            # exact shapes, so serve-coalesced lanes keep the unconditional
+            # solo-bitwise guarantee.
+            from ..ops.scoring import pad_rows_np
+
+            X, y, w = pad_rows_np(X, y, w, n_bucket)
+        dataset = Dataset(X, y, weights=w)
+        self.dataset = dataset
+
+        # mirror equation_search's single-output entry: one fresh stream per
+        # search, seeded from Options.seed
+        rng = np.random.default_rng(options.seed)
+
+        eng_dt = np.dtype(options.dtype)
+        if eng_dt == np.float64:
+            from ..utils.precision import ensure_x64_for_dtype
+
+            ensure_x64_for_dtype(eng_dt)
+        Xe = dataset.X.astype(eng_dt)
+        ye = dataset.y.astype(eng_dt)
+        we = None if dataset.weights is None else dataset.weights.astype(eng_dt)
+
+        # baseline loss — identical host-side arithmetic to the solo driver
+        avg = dataset.avg_y
+        elem = np.asarray(options.loss(np.full_like(ye, avg), ye), np.float64)
+        if we is not None:
+            bl = float((elem * we).sum() / we.sum())
+        else:
+            bl = float(elem.mean())
+        use_baseline = bool(np.isfinite(bl))
+        dataset.baseline_loss = bl if use_baseline else 1.0
+        dataset.use_baseline = use_baseline
+
+        I, P = options.populations, options.population_size
+        self.I, self.P = I, P
+        cfg = build_evo_config(
+            options,
+            n_features=dataset.n_features,
+            baseline_loss=dataset.baseline_loss,
+            use_baseline=use_baseline,
+            niterations=self.nit,
+            n_islands=I,
+            n_rows=dataset.n,
+            dataset=dataset,
+        )
+        if cfg.warmup_maxsize_by == 0:
+            cfg = dataclasses.replace(cfg, niterations=0)
+        self.cfg = cfg
+        self.ecfg = dataclasses.replace(cfg, baseline_loss=1.0, use_baseline=True)
+
+        import jax
+
+        use_pallas = (
+            (jax.devices()[0].platform != "cpu" or _pallas_interpret())
+            and eng_dt == np.float32
+            and options.loss_function_jit is None
+        )
+        if use_pallas:
+            from ..ops.interp_pallas import pallas_supported
+
+            use_pallas = pallas_supported(
+                options.operators, dataset.n_features, options.loss
+            )
+        use_pallas_grad = False
+        if (
+            use_pallas
+            and options.should_optimize_constants
+            and options.optimizer_algorithm == "BFGS"
+        ):
+            from ..ops.interp_pallas import pallas_grad_supported
+
+            use_pallas_grad = pallas_grad_supported(
+                options.operators, dataset.n_features, options.loss
+            )
+        self.use_pallas = use_pallas
+        self.use_pallas_grad = use_pallas_grad
+
+        ds_key = _dataset_key(Xe, ye, we)
+        norm_val = (
+            dataset.baseline_loss
+            if (use_baseline and dataset.baseline_loss >= 0.01)
+            else 0.01
+        )
+        need_raw = (
+            options.batching
+            or not use_pallas
+            or (options.should_optimize_constants and not use_pallas_grad)
+        )
+        self.score_fn, self.score_data = _make_score_fn(
+            Xe, ye, we, options, use_pallas, ds_key=ds_key, norm=norm_val,
+            need_raw=need_raw,
+        )
+        self.score_call = lambda batch: self.score_fn.jitted(
+            batch, self.score_data
+        )
+
+        self.bs_local = None
+        if cfg.batching:
+            self.bs_local = max(1, min(int(options.batch_size), dataset.n))
+        has_w = we is not None
+        self.copt_key = None
+        self.make_copt = None
+        if options.should_optimize_constants:
+            if use_pallas_grad:
+                self.make_copt = (
+                    lambda c, jit=True: _make_const_opt_fn_pallas(
+                        options, c, dataset.n, has_w,
+                        batch_rows=self.bs_local, jit=jit,
+                    )
+                )
+            else:
+                self.make_copt = lambda c, jit=True: _make_const_opt_fn(
+                    options, c, has_w, batch_rows=self.bs_local, jit=jit
+                )
+            self.copt_key = (
+                Xe.shape, has_w, options.operators, options.loss,
+                options.loss_function_jit,
+                options.optimizer_probability, options.optimizer_nrestarts,
+                options.optimizer_iterations, options.optimizer_algorithm,
+                options.optimizer_g_tol, _copt_env(), bucket_min(),
+            )
+
+        # pipelined readback: the solo auto default (replay is impossible in
+        # a fleet, so only profiling forces the synchronous path)
+        async_rb = options.async_readback
+        if async_rb is None:
+            async_rb = not options.profile
+        if options.profile:
+            async_rb = False
+        self.async_rb = bool(async_rb)
+
+        self.do_simplify = (
+            options.should_simplify
+            and "no_simplify" not in os.environ.get("SR_ABLATE", "").split(",")
+        )
+        self.early_stop = options.early_stop_fn()
+        self.hof = HallOfFame(options.maxsize)
+        self.device_evals = 0.0
+        self.host_evals = 0.0
+        self.num_evals = 0.0
+        self.stop_reason: str | None = None
+
+        # --- initial populations -> scored device EvoState (solo build_state)
+        init_trees = Population.random_trees(
+            I * P, options, dataset.n_features, rng
+        )
+        seed = int(rng.integers(0, 2**31 - 1))
+        N = options.max_nodes
+        bflat = flatten_trees(init_trees, N, dtype=eng_dt)
+        batch0 = Tree(
+            jnp.asarray(bflat.kind), jnp.asarray(bflat.op),
+            jnp.asarray(bflat.lhs), jnp.asarray(bflat.rhs),
+            jnp.asarray(bflat.feat), jnp.asarray(bflat.val),
+            jnp.asarray(bflat.length),
+        )
+        b_losses = self.score_call(batch0)
+        if cfg.units_check:
+            from ..ops.evolve import dim_penalty_batch_jit
+
+            b_losses = b_losses + dim_penalty_batch_jit(batch0, self.ecfg)
+        st = init_state(bflat, np.zeros(I * P), self.ecfg, seed)
+        from ..ops.evolve import _complexity_members
+
+        comp = _complexity_members(st, self.ecfg).astype(jnp.float32)
+        loss_dev = b_losses.reshape(I, P)
+        self.state = st._replace(
+            loss=loss_dev, score=_score_of(loss_dev, comp, cfg)
+        )
+
+
+def _fleet_dummy_pool(ecfg: EvoConfig):
+    """All-invalid [maxsize+1] migration pool: injected with apply=False (or
+    drawn-never thanks to inf losses) — the no-op filler for lanes without a
+    simplify pool this iteration."""
+    import jax.numpy as jnp
+
+    S1 = ecfg.maxsize + 1
+    N = ecfg.n_slots
+    zi = jnp.zeros((S1, N), jnp.int32)
+    return (
+        zi.at[:, 0].set(1), zi, zi, zi, zi,
+        jnp.zeros((S1, N), jnp.dtype(ecfg.val_dtype)),
+        jnp.ones((S1,), jnp.int32),
+        jnp.full((S1,), jnp.inf, jnp.dtype(ecfg.val_dtype)),
+    )
+
+
+def fleet_search(
+    specs,
+    verbosity: int = 0,
+    coalesce_wait_s: float = 0.0,
+    on_lane_done=None,
+    lane_bucket: int | None = None,
+):
+    """Run N compatible single-output searches as ONE vmapped megaprogram
+    per iteration. Returns ``[SearchResult]`` in spec order.
+
+    Every lane must be fleet-eligible (``fleet_eligibility``) and the lanes
+    must share one engine configuration: equal engine EvoConfig (operators,
+    sizes, cycles — everything but the per-lane baseline/seed) and one
+    memoized score fn (same shapes after row-bucket padding). Per-lane
+    niterations / timeout / max_evals / early-stop / iteration_callback are
+    honored individually: a finished lane freezes (bitwise) under the fleet
+    mask while the rest drain.
+
+    ``lane_bucket`` pads the fleet axis to a fixed width with inert lanes
+    (replicas of lane 0, never active, results discarded) so batches of
+    different sizes share ONE compiled megaprogram — the fleet analogue of
+    the row/length buckets. Real-lane results are unchanged: the lane axis
+    is data-parallel, so extra lanes cannot perturb a real lane's values.
+
+    ``on_lane_done(idx, result)`` fires as each lane finalizes — the serve
+    layer uses it to complete jobs without waiting for the whole fleet.
+    ``coalesce_wait_s`` is bookkeeping only (profiler counter)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..search import SearchResult  # late import (module cycle)
+    from ..utils.profiling import NULL_PROFILER, StageProfiler
+
+    specs = list(specs)
+    L = len(specs)
+    if L == 0:
+        return []
+    for spec in specs:
+        reason = fleet_eligibility(spec.options)
+        if reason is not None:
+            raise ValueError(f"spec not fleet-eligible: {reason}")
+
+    ns = [np.asarray(s.y).shape[0] for s in specs]
+    n_bucket = max(ns)
+    # mixed row counts (or mixed weight presence) force explicit weights on
+    # EVERY lane so the stacked ScoreData pytree is uniform; see _FleetLane
+    force_weights = any(s.weights is not None for s in specs) or any(
+        n < n_bucket for n in ns
+    )
+    cache_stats0 = (
+        PROGRAM_CACHE.stats()
+        if any(s.options.profile for s in specs)
+        else None
+    )
+    lanes = [
+        _FleetLane(i, s, n_bucket, force_weights) for i, s in enumerate(specs)
+    ]
+    # padded fleet width: Lb >= L inert lanes so every batch size in
+    # [1, lane_bucket] reuses one compiled program (cache keys use Lb)
+    Lb = max(L, lane_bucket) if lane_bucket else L
+    pad = Lb - L
+
+    lead = lanes[0]
+    ecfg = lead.ecfg
+    score_fn = lead.score_fn
+    for lane in lanes[1:]:
+        if lane.ecfg != ecfg:
+            raise ValueError(
+                "fleet lanes must share one engine EvoConfig (operators, "
+                "population geometry, cycles, maxsize, dtype, batching); "
+                f"lane {lane.idx} ({lane.spec.label!r}) differs"
+            )
+        if lane.score_fn is not score_fn:
+            raise ValueError(
+                "fleet lanes must share one memoized score fn (same dataset "
+                f"shapes + scoring options); lane {lane.idx} differs"
+            )
+        if (
+            lane.async_rb != lead.async_rb
+            or lane.use_pallas_grad != lead.use_pallas_grad
+            or lane.copt_key != lead.copt_key
+            or lane.options.jit_warmup != lead.options.jit_warmup
+        ):
+            raise ValueError(
+                "fleet lanes must agree on async_readback/profile, the "
+                f"const-opt configuration, and jit_warmup; lane {lane.idx} "
+                "differs"
+            )
+    async_rb = lead.async_rb
+    copt_impl = lead.make_copt(ecfg, jit=False) if lead.make_copt else None
+    fin_sfn = score_fn if ecfg.batching else None
+    frac_hof = float(lead.options.fraction_replaced_hof)
+
+    # stacked device state + dataset: [Lb, ...] leading fleet axis (pad
+    # lanes replicate lane 0 and stay inactive for the whole run)
+    state_f = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *([lane.state for lane in lanes] + [lanes[0].state] * pad),
+    )
+    data_f = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *([lane.score_data for lane in lanes] + [lanes[0].score_data] * pad),
+    )
+    for lane in lanes:
+        lane.state = None  # the stacked copy is authoritative now
+
+    active = [lane.nit > 0 for lane in lanes] + [False] * pad
+    active_dev = jnp.asarray(np.asarray(active))
+
+    from ..ops.evolve import (
+        fleet_migrate_from_pool,
+        run_fleet_iteration_fused,
+        run_fleet_iteration_fused_donated,
+    )
+
+    # --- AOT warmup under the fleet-specific cache kinds ("fleet_aot"):
+    # program_cache.stats()["by_kind"] then separates fleet-program traffic
+    # from solo "aot" traffic, keeping serve warm-ratio stats honest
+    base_fused = (
+        run_fleet_iteration_fused_donated if async_rb else run_fleet_iteration_fused
+    )
+    rb_pack = _make_readback_fn(ecfg)
+    fleet_rb = jax.jit(jax.vmap(rb_pack))
+    if lead.options.jit_warmup:
+        k_fused = (
+            "fleet", Lb, ecfg, score_fn, async_rb, ecfg.batching,
+            lead.use_pallas_grad, _pallas_interpret(), lead.copt_key,
+        )
+        fused_step = PROGRAM_CACHE.get("fleet_aot", k_fused)
+        if fused_step is None:
+            fused_step = base_fused.lower(
+                state_f, active_dev, data_f, ecfg, score_fn, copt_impl, fin_sfn
+            ).compile()
+            fused_step = PROGRAM_CACHE.put("fleet_aot", k_fused, fused_step)
+        k_rb = ("fleet_rb", Lb, ecfg)
+        rb_step = PROGRAM_CACHE.get("fleet_aot", k_rb)
+        if rb_step is None:
+            rb_step = fleet_rb.lower(state_f).compile()
+            rb_step = PROGRAM_CACHE.put("fleet_aot", k_rb, rb_step)
+        if any(lane.do_simplify for lane in lanes):
+            # prime the injection + pool-rescore programs (fixed [maxsize+1]
+            # shapes) exactly like the solo warmup: all-invalid pool, apply
+            # nowhere, result discarded
+            dummy = _fleet_dummy_pool(ecfg)
+            pool_f = jax.tree_util.tree_map(
+                lambda x: jnp.stack([x] * Lb), dummy
+            )
+            fleet_migrate_from_pool(
+                state_f, ecfg, pool_f,
+                jnp.zeros((Lb,), bool), frac_hof, data_f.norm,
+            )
+            lead.score_call(
+                Tree(*dummy[:6], dummy[6])
+            ).block_until_ready()
+    else:
+        fused_step = lambda st, act, d: base_fused(  # noqa: E731
+            st, act, d, ecfg, score_fn, copt_impl, fin_sfn
+        )
+        rb_step = fleet_rb
+
+    prof = (
+        StageProfiler()
+        if any(lane.options.profile for lane in lanes)
+        else NULL_PROFILER
+    )
+    from ..search import IterationReport
+
+    dummy_pool = None
+    start_time = time.time()
+    results: list = [None] * L
+    pending = None  # [rb_f, consumer lane set] — the pipelined carry
+    nit_max = max(lane.nit for lane in lanes)
+
+    def _consume_rows(buf: np.ndarray, consumers) -> None:
+        """Demux one stacked readback into per-lane hofs + simplify pools,
+        then apply all lanes' injections as ONE masked fleet program."""
+        nonlocal state_f, dummy_pool
+        t0 = time.perf_counter()
+        pools = {}
+        for l in sorted(consumers):
+            lane = lanes[l]
+            bs_loss, bs_exists, bs_len, fields, dev_evals = _decode_readback(
+                buf[l], lane.cfg
+            )
+            lane.device_evals = dev_evals
+            members = _bs_to_members(
+                bs_loss, bs_exists, bs_len, fields, lane.cfg, lane.options
+            )
+            for m in members:
+                lane.hof.update(m, lane.options)
+            if lane.do_simplify:
+                pool, n_scored = _simplified_frontier_pool(
+                    members, lane.options, lane.cfg, lane.score_call, lane.hof
+                )
+                lane.host_evals += n_scored
+                if pool is not None:
+                    pools[l] = pool
+            lane.num_evals = lane.device_evals + lane.host_evals
+        if pools:
+            if dummy_pool is None:
+                dummy_pool = _fleet_dummy_pool(ecfg)
+            pool_f = tuple(
+                jnp.stack([
+                    pools.get(l, dummy_pool)[j] for l in range(Lb)
+                ])
+                for j in range(8)
+            )
+            apply_f = jnp.asarray(
+                np.asarray([l in pools for l in range(Lb)])
+            )
+            state_f = fleet_migrate_from_pool(
+                state_f, ecfg, pool_f, apply_f, frac_hof, data_f.norm
+            )
+        prof.add_time("fleet/demux", time.perf_counter() - t0)
+
+    def _finalize_lane(l: int, stop_code: int) -> None:
+        """The solo post-loop sequence for one lane: flush its pending
+        readback (simplify injection included), decode its state slice, fold
+        final populations into the hof, build the SearchResult."""
+        nonlocal pending
+        lane = lanes[l]
+        active[l] = False
+        if pending is not None and l in pending[1]:
+            pending[1].discard(l)
+            _consume_rows(np.asarray(pending[0]), (l,))
+        lane_state = jax.tree_util.tree_map(lambda a: a[l], state_f)
+        pops, _, _ = _decode_state_populations(
+            lane_state, lane.I, lane.P, lane.cfg, lane.options
+        )
+        for pop in pops:
+            lane.hof.update_many(pop.members, lane.options)
+        result = SearchResult(
+            hall_of_fame=lane.hof,
+            populations=pops,
+            dataset=lane.dataset,
+            options=lane.options,
+            num_evals=lane.num_evals,
+        )
+        result.stop_reason = {
+            0: None, 1: "early_stop", 2: "timeout", 3: "max_evals",
+            5: "callback",
+        }[stop_code]
+        result.iteration_seconds = time.time() - start_time
+        results[l] = result
+        if on_lane_done is not None:
+            on_lane_done(l, result)
+
+    for l, lane in enumerate(lanes):
+        if lane.nit <= 0:
+            _finalize_lane(l, 0)
+    if any(active):
+        active_dev = jnp.asarray(np.asarray(active))
+
+    for it in range(nit_max):
+        if not any(active):
+            break
+        with prof.stage("fused_iter"):
+            _count_dispatch("fused_iter")
+            state_f = fused_step(state_f, active_dev, data_f)
+            prof.fence(state_f)
+        with prof.stage("readback_pack"):
+            _count_dispatch("readback")
+            rb_f = rb_step(state_f)
+            prof.fence(rb_f)
+        if async_rb:
+            rb_f.copy_to_host_async()
+            prev, pending = pending, [rb_f, {l for l in range(L) if active[l]}]
+            if prev is not None and prev[1]:
+                # srl: disable=SRL003 -- pipelined design point: consumes the PREVIOUS iteration's buffer after copy_to_host_async
+                _consume_rows(np.asarray(prev[0]), prev[1])
+        else:
+            with prof.stage("readback_d2h"):
+                buf = np.asarray(rb_f)  # srl: disable=SRL003 -- sync-readback mode (profiling): deliberate
+            _consume_rows(buf, {l for l in range(L) if active[l]})
+
+        t_now = time.time()
+        changed = False
+        for l in range(L):
+            if not active[l]:
+                continue
+            lane = lanes[l]
+            stop_code = 0
+            if lane.options.iteration_callback is not None:
+                if lane.options.iteration_callback(
+                    IterationReport(
+                        iteration=it + 1,
+                        niterations=lane.nit,
+                        hall_of_fame=lane.hof,
+                        num_evals=float(lane.num_evals),
+                        elapsed=t_now - start_time,
+                    )
+                ):
+                    stop_code = 5
+            if stop_code == 0:
+                if lane.early_stop is not None and any(
+                    lane.early_stop(m.loss, m.get_complexity(lane.options))
+                    for m in lane.hof.pareto_frontier()
+                ):
+                    stop_code = 1
+                elif (
+                    lane.options.timeout_in_seconds is not None
+                    and t_now - start_time > lane.options.timeout_in_seconds
+                ):
+                    stop_code = 2
+                elif (
+                    lane.options.max_evals is not None
+                    and lane.num_evals >= lane.options.max_evals
+                ):
+                    stop_code = 3
+            if stop_code or it + 1 >= lane.nit:
+                _finalize_lane(l, stop_code)
+                changed = True
+        if changed and any(active):
+            active_dev = jnp.asarray(np.asarray(active))
+        if verbosity > 0:
+            live = sum(active)
+            print(
+                f"[fleet iter {it + 1}/{nit_max}] lanes={L} live={live}"
+            )
+        prof.next_iteration()
+
+    if prof.enabled:
+        cs = PROGRAM_CACHE.stats()
+        prof.set_counters(
+            "fleet",
+            {
+                "lanes": L,
+                "lane_bucket": Lb,
+                "coalesce_wait_s": float(coalesce_wait_s),
+            },
+        )
+        prof.set_counters(
+            "program_cache",
+            {
+                "hits": cs["hits"] - cache_stats0["hits"],
+                "misses": cs["misses"] - cache_stats0["misses"],
+                "evictions": cs["evictions"] - cache_stats0["evictions"],
+                # fleet-program reuse vs solo-program reuse, separately —
+                # a warm fleet shows fleet_misses == 0 even while lanes
+                # still miss on their per-lane score fns
+                "fleet_hits": cs["fleet"]["hits"] - cache_stats0["fleet"]["hits"],
+                "fleet_misses": (
+                    cs["fleet"]["misses"] - cache_stats0["fleet"]["misses"]
+                ),
+                "entries": cs["entries"],
+                "data_bytes": cs["data_bytes"],
+            },
+        )
+        summary = prof.summary()
+        for result in results:
+            result.engine_profile = summary
+    return results

@@ -94,6 +94,9 @@ __all__ = [
     "make_sharded_finalize",
     "extract_topn_pool",
     "migrate_from_pool",
+    "fleet_migrate_from_pool",
+    "run_fleet_iteration_fused",
+    "run_fleet_iteration_fused_donated",
     "merge_best_seen",
 ]
 
@@ -1560,6 +1563,61 @@ run_iteration_fused_donated = functools.partial(
 )(_run_iteration_fused_impl)
 
 
+def _freeze_inactive(new: EvoState, old: EvoState, active):
+    """Per-lane freeze for the fleet axis: keep ``new`` where the lane is
+    active, the untouched ``old`` otherwise. ``active`` is a scalar bool
+    under vmap, so the select broadcasts over every EvoState leaf — a
+    stopped lane's state (INCLUDING its RNG key and counters) is bitwise
+    frozen at its stop iteration, which is what lets a drained lane's final
+    decode equal the solo run's."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(active, n, o), new, old
+    )
+
+
+def _run_fleet_iteration_fused_impl(
+    state: EvoState, active, data, cfg: EvoConfig, score_fn, copt_impl=None,
+    fin_score_fn=None,
+) -> EvoState:
+    """N concurrent searches as ONE megaprogram per iteration: the fused
+    per-iteration impl vmapped over a leading fleet axis of (EvoState,
+    ScoreData) with per-lane ``active`` masking.
+
+    Bitwise contract (pinned by tests/test_fleet.py): vmap adds a batch
+    dimension without changing any lane's elementwise computation, so an
+    active lane's state advances bit-identically to the same search run
+    solo through ``run_iteration_fused`` — RNG included (each lane carries
+    its own key) — and a masked lane is frozen verbatim. Per-lane datasets
+    travel as the stacked traced ``data``, so one compiled fleet executable
+    serves every same-shape fleet of the same width."""
+    if cfg.record_events:
+        raise ValueError(
+            "fleet iteration does not support record_events (per-lane "
+            "replay logs are not demuxed; run recorder sessions solo)"
+        )
+
+    def lane(st, act, d):
+        new = _run_iteration_fused_impl(
+            st, d, cfg, score_fn, copt_impl, fin_score_fn
+        )
+        return _freeze_inactive(new, st, act)
+
+    return jax.vmap(lane)(state, active, data)
+
+
+run_fleet_iteration_fused = functools.partial(
+    jax.jit, static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn")
+)(_run_fleet_iteration_fused_impl)
+
+# donated twin (see run_iteration_fused_donated): one set of stacked fleet
+# state buffers threads through every iteration with zero copies
+run_fleet_iteration_fused_donated = functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn"),
+    donate_argnums=(0,),
+)(_run_fleet_iteration_fused_impl)
+
+
 def make_sharded_finalize(mesh, cfg_local: EvoConfig, score_fn, data_specs=None):
     """shard_map twin of make_sharded_iteration for the finalize program."""
     specs = evo_state_specs()
@@ -1788,6 +1846,17 @@ def extract_topn_pool(state: EvoState, cfg: EvoConfig):
     return _topn_pool(state, cfg)
 
 
+def _migrate_from_pool_impl(
+    state: EvoState, cfg: EvoConfig, pool, frac: float, norm=None
+):
+    pool_valid = jnp.isfinite(pool[7]) & (pool[6] >= 1)
+    out = _inject_pool(state, cfg, pool, pool_valid, frac, norm)
+    if not cfg.record_events:
+        return out
+    state, replace, src = out
+    return state, {"replace": replace, "src": src, "pool": pool}
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "frac"))
 def migrate_from_pool(
     state: EvoState, cfg: EvoConfig, pool, frac: float, norm=None
@@ -1797,12 +1866,27 @@ def migrate_from_pool(
     Invalid rows (non-finite loss or length < 1) are never drawn. ``norm``:
     traced score normalization (ScoreData.norm) so the program is
     dataset-independent."""
-    pool_valid = jnp.isfinite(pool[7]) & (pool[6] >= 1)
-    out = _inject_pool(state, cfg, pool, pool_valid, frac, norm)
-    if not cfg.record_events:
-        return out
-    state, replace, src = out
-    return state, {"replace": replace, "src": src, "pool": pool}
+    return _migrate_from_pool_impl(state, cfg, pool, frac, norm)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "frac"))
+def fleet_migrate_from_pool(
+    state: EvoState, cfg: EvoConfig, pool, apply, frac: float, norm=None
+) -> EvoState:
+    """Fleet twin of migrate_from_pool: ``state``/``pool``/``norm`` carry a
+    leading fleet axis and ``apply`` is a per-lane bool. Lanes with
+    ``apply=False`` are frozen verbatim — crucially their RNG key is NOT
+    consumed, exactly matching a solo run that skipped the migrate call
+    (a lane whose simplify pass produced nothing must not diverge from its
+    solo reference just because a fleetmate's did)."""
+    if cfg.record_events:
+        raise ValueError("fleet migration does not support record_events")
+
+    def lane(st, pl, ap, nm):
+        new = _migrate_from_pool_impl(st, cfg, pl, frac, nm)
+        return _freeze_inactive(new, st, ap)
+
+    return jax.vmap(lane)(state, pool, apply, norm)
 
 
 def scoring_cost_probe(

@@ -489,7 +489,7 @@ def pack_flat_fused(flat: FlatTrees, opset: OperatorSet):
     return jnp.asarray(ints), jnp.asarray(vals)
 
 
-def pack_rows_np(X, y, weights):
+def pack_rows_np(X, y, weights, n_bucket=None):
     """THE numpy core of the kernel row layout: pad rows to a multiple of
     8*C_TILE (X pads with 1.0 so no operator domain-faults on pads; w pads
     with 0 so pads never weigh in) and fold into (8, cols) VPU sublane
@@ -497,9 +497,25 @@ def pack_rows_np(X, y, weights):
     occupies Xp sublane rows 8f..8f+8. Shared by _reshape_rows (device
     upload) and the rows-sharded per-block packer
     (models/device_search._make_score_data_rows) — ONE implementation of
-    the layout invariants."""
+    the layout invariants.
+
+    ``n_bucket`` (fleet path) first pads the ROW axis to a shared fleet row
+    bucket via ``scoring.pad_rows_np`` — pad rows replicate row 0 with
+    weight 0 — so lanes with fewer rows run at the bucket's static R.
+    The kernels' in-tile masking (``iota < R`` with the masked loss summing
+    ``where(mask, elem * w, 0)`` and ``wsum = sum(w_masked)``) then treats
+    those in-bucket pads exactly like real rows, and their zero weight makes
+    their contribution an exact 0.0 in both the numerator and the weight
+    sum; because the padded R lands in the same 8*C_TILE tile bucket, the
+    compiled program and reduction ORDER are identical too — losses and
+    gradients stay bit-identical to the lane's solo run (pinned by
+    tests/test_fleet.py)."""
     X = np.asarray(X, np.float32)
     y = np.asarray(y, np.float32)
+    if n_bucket is not None:
+        from .scoring import pad_rows_np
+
+        X, y, weights = pad_rows_np(X, y, weights, n_bucket)
     F, R = X.shape
     R_pad = _round_up(R, 8 * C_TILE)
     C = R_pad // 8

@@ -38,6 +38,7 @@ __all__ = [
     "batched_loss_bucketed",
     "objective_loss_jit",
     "loss_to_score",
+    "pad_rows_np",
     "baseline_loss",
 ]
 
@@ -191,6 +192,45 @@ def objective_loss_jit(flat, X, y, weights, opset, objective) -> jax.Array:
     has_weights = weights is not None
     w = weights if has_weights else np.zeros((), X.dtype)
     return _objective_loss_jit(flat, X, y, w, opset, objective, has_weights)
+
+
+def pad_rows_np(X, y, weights, n_bucket: int):
+    """Pad a dataset's row axis to a fleet row bucket, host-side (numpy).
+
+    Returns ``(Xp [F, n_bucket], yp [n_bucket], wp [n_bucket])`` where the
+    pad rows REPLICATE row 0 of the real data and carry weight 0.0, and
+    ``wp`` is always materialized (ones over the real rows when ``weights``
+    is None). Under the weighted-mean loss reduction a zero-weight row
+    contributes an exact ``0.0`` to both the loss numerator and the weight
+    sum, and replicating a REAL row (rather than synthesizing values) means
+    the evaluation/finiteness of the pad rows matches row 0 exactly — so the
+    padded loss is bit-identical to the unpadded solo loss, on both the
+    interpreter path and the Pallas kernels (whose static-R tile masking
+    already zeroes out-of-bucket positions; see ``interp_pallas.pack_rows_np``).
+
+    Known (documented) edge: if the ELEMENT loss overflows to inf on row 0
+    while its prediction is finite, the pad contribution is ``inf * 0 = NaN``
+    and the padded loss is NaN where the solo loss was inf — both non-finite,
+    both rejected identically by the inf-guard, so candidate ordering is
+    unaffected.
+    """
+    X = np.asarray(X)
+    y = np.asarray(y)
+    n = y.shape[0]
+    if n_bucket < n:
+        raise ValueError(f"n_bucket {n_bucket} < dataset rows {n}")
+    w = (
+        np.ones((n,), dtype=y.dtype)
+        if weights is None
+        else np.asarray(weights, dtype=y.dtype)
+    )
+    pad = n_bucket - n
+    if pad == 0:
+        return X, y, w
+    Xp = np.concatenate([X, np.repeat(X[:, :1], pad, axis=1)], axis=1)
+    yp = np.concatenate([y, np.repeat(y[:1], pad)])
+    wp = np.concatenate([w, np.zeros((pad,), dtype=y.dtype)])
+    return Xp, yp, wp
 
 
 def loss_to_score(

@@ -32,6 +32,7 @@ from .queue import (
     JobQueue,
     JobSpec,
     options_digest,
+    queue_age_seconds,
     shape_bucket,
 )
 from .server import SearchServer
@@ -46,6 +47,7 @@ __all__ = [
     "SearchServer",
     "shape_bucket",
     "options_digest",
+    "queue_age_seconds",
     "QUEUED",
     "RUNNING",
     "PREEMPTED",

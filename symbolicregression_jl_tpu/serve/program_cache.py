@@ -227,6 +227,11 @@ class ProgramCache:
             }
             hits = sum(self._hits.values())
             misses = sum(self._misses.values())
+            # Fleet programs live under kinds prefixed "fleet" ("fleet_aot",
+            # "fleet_rb") — roll them up so operators can tell fleet-program
+            # reuse apart from solo "aot" reuse at a glance.
+            fleet_hits = sum(v for k, v in self._hits.items() if k.startswith("fleet"))
+            fleet_misses = sum(v for k, v in self._misses.items() if k.startswith("fleet"))
             return {
                 "hits": hits,
                 "misses": misses,
@@ -237,6 +242,12 @@ class ProgramCache:
                 "data_budget_bytes": self.data_budget_bytes,
                 "hit_ratio": hits / (hits + misses) if hits + misses else 0.0,
                 "by_kind": by_kind,
+                "fleet": {
+                    "hits": fleet_hits,
+                    "misses": fleet_misses,
+                    "solo_hits": hits - fleet_hits,
+                    "solo_misses": misses - fleet_misses,
+                },
             }
 
 

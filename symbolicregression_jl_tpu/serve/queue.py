@@ -13,6 +13,14 @@ The queue admits jobs to workers by, in order:
    knob);
 3. **submit order** (FIFO) — fairness within a warm bucket.
 
+Warmth ordering alone can starve a cold-bucket job indefinitely under a
+steady same-priority warm stream, so warmth is bounded by **submit-age
+escalation**: once a queued job has waited longer than ``SR_QUEUE_AGE_S``
+(seconds, default 30; ``0`` disables aging), its warmth term is forced to
+the warm value — an aged cold-bucket job competes on FIFO order alone and
+the warm stream can no longer leapfrog it. Priority still dominates: aging
+never promotes a job past a higher-priority one.
+
 Per-tenant quotas bound how many of a tenant's jobs RUN concurrently (queued
 jobs are unlimited): a tenant flooding the queue cannot starve others of
 worker slots, only of its own.
@@ -23,13 +31,17 @@ Everything here is host-side stdlib: the queue never touches jax.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Any
 
 import numpy as np
 
-__all__ = ["JobSpec", "Job", "JobQueue", "shape_bucket", "options_digest"]
+__all__ = [
+    "JobSpec", "Job", "JobQueue", "shape_bucket", "options_digest",
+    "queue_age_seconds",
+]
 
 
 # -- terminal + transient job states ------------------------------------------
@@ -45,6 +57,16 @@ CANCELLED = "cancelled"
 TERMINAL_STATES = frozenset({DONE, FAILED, EXPIRED, CANCELLED})
 
 
+def queue_age_seconds() -> float:
+    """SR_QUEUE_AGE_S: queued age past which a job's effective admission
+    priority rises past shape-bucket warmth (head-of-line aging). 0 disables
+    aging. Read per admission pass — a live server honors changes."""
+    try:
+        return float(os.environ.get("SR_QUEUE_AGE_S", "30"))
+    except ValueError:
+        return 30.0
+
+
 def options_digest(options) -> tuple:
     """Hashable digest of the Options axes that select compiled programs —
     the serve-level analogue of the engine cache keys (which hold the config
@@ -52,8 +74,12 @@ def options_digest(options) -> tuple:
     digests build equal cache keys in-process)."""
     from ..utils.checkpoint import options_fingerprint
 
+    # The checkpoint fingerprint ends with options.seed; the seed never
+    # selects a compiled program (it is runtime data, EvoConfig carries no
+    # seed), so it is sliced off here — jobs differing only by seed share a
+    # bucket and can coalesce into one fleet.
     return (
-        options_fingerprint(options),
+        options_fingerprint(options)[:-1],
         options.scheduler,
         str(np.dtype(options.dtype)),
         int(options.maxsize),
@@ -214,15 +240,21 @@ class JobQueue:
         # caller holds the lock
         best = None
         best_key = None
+        age_s = queue_age_seconds()
+        now = time.time()
         for job in self._pending:
             if job.cancel_requested.is_set():
                 continue
             tenant = job.spec.tenant
             if self._running_by_tenant.get(tenant, 0) >= self._quota(tenant):
                 continue
+            # head-of-line aging: a job queued past SR_QUEUE_AGE_S competes
+            # as if its bucket were warm, so a steady warm stream cannot
+            # starve cold-bucket submissions (priority still dominates)
+            aged = age_s > 0 and now - job.submitted_at >= age_s
             key = (
                 -job.spec.priority,
-                0 if job.bucket in warm_buckets else 1,
+                0 if aged or job.bucket in warm_buckets else 1,
                 job.seq,
             )
             if best is None or key < best_key:
@@ -250,6 +282,40 @@ class JobQueue:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0 or not self._cond.wait(remaining):
                         return None
+
+    def take_compatible(self, lead: Job, limit: int) -> list[Job]:
+        """Pop up to ``limit`` further queued jobs coalescible with ``lead``
+        into one fleet batch, charging each tenant's quota like ``acquire``.
+
+        Compatible means: identical shape bucket (so the lanes share every
+        compiled program and need no row padding), no deadline (deadline-
+        urgent jobs run solo so their wall budget is not hostage to fleet
+        drain), no resume checkpoint (a preempted job warm-starts solo), and
+        not cancelled. FIFO within the bucket; never blocks."""
+        out: list[Job] = []
+        with self._cond:
+            taken = []
+            for job in sorted(self._pending, key=lambda j: j.seq):
+                if len(out) >= limit:
+                    break
+                if job.cancel_requested.is_set():
+                    continue
+                if job.bucket != lead.bucket:
+                    continue
+                if job.deadline_at is not None or job.resume_path is not None:
+                    continue
+                tenant = job.spec.tenant
+                if self._running_by_tenant.get(tenant, 0) >= self._quota(tenant):
+                    continue
+                taken.append(job)
+                self._running_by_tenant[tenant] = (
+                    self._running_by_tenant.get(tenant, 0) + 1
+                )
+                job.state = RUNNING
+                out.append(job)
+            for job in taken:
+                self._pending.remove(job)
+        return out
 
     def release(self, job: Job) -> None:
         """Return the tenant's quota slot when a job leaves RUNNING (to a

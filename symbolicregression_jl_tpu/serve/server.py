@@ -23,7 +23,18 @@ the server's whole job is to keep that cache hot:
   ``resume_from`` so the search warm-starts over its REMAINING iterations;
 - **warm restarts**: ``enable_persistent_compilation_cache`` wires jax's
   on-disk XLA cache (``SR_COMPILATION_CACHE_DIR``), so even a restarted
-  server re-materializes executables from disk instead of recompiling.
+  server re-materializes executables from disk instead of recompiling;
+- **fleet coalescing** (opt-in, ``fleet=True``): a worker that pops a
+  fleet-eligible job gathers up to ``SR_FLEET_MAX - 1`` (default 8 lanes
+  total) further queued jobs from the SAME shape bucket — waiting up to
+  ``SR_FLEET_WINDOW_S`` (default 0.05s) for stragglers — and runs them as
+  ONE vmapped megaprogram via ``models.device_search.fleet_search``: N
+  searches per iteration for a solo search's <=2 dispatches. Each job keeps
+  its own frontier stream (frames demux from the stacked hall of fame),
+  stop conditions, and terminal state; cancel/preempt evicts a single lane
+  (the lane freezes under the fleet mask, survivors drain unchanged).
+  Deadline-bearing jobs and preemption resumes bypass coalescing and run
+  solo.
 
 The server is in-process by design (the engine is a Python library; remote
 transport is a thin shell over ``submit``/``frames``/``result`` and out of
@@ -33,7 +44,9 @@ jobs' events, so a transport can drive it from any thread.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
+import hashlib
 import os
 import shutil
 import tempfile
@@ -70,11 +83,31 @@ class SearchServer:
         spool_dir: str | None = None,
         compilation_cache_dir: str | None = None,
         poll_seconds: float = 0.2,
+        fleet: bool = False,
+        fleet_max: int | None = None,
+        fleet_window_s: float | None = None,
     ):
         if max_concurrency < 1:
             raise ValueError("max_concurrency must be >= 1")
         self.max_concurrency = int(max_concurrency)
         self.poll_seconds = float(poll_seconds)
+        self.fleet = bool(fleet)
+        self.fleet_max = (
+            int(os.environ.get("SR_FLEET_MAX", "8"))
+            if fleet_max is None
+            else int(fleet_max)
+        )
+        if self.fleet and self.fleet_max < 2:
+            raise ValueError("fleet_max must be >= 2 when fleet mode is on")
+        self.fleet_window_s = (
+            float(os.environ.get("SR_FLEET_WINDOW_S", "0.05"))
+            if fleet_window_s is None
+            else float(fleet_window_s)
+        )
+        self._fleet_batches = 0
+        self._fleet_lanes = 0
+        self._fleet_max_seen = 0
+        self._fleet_deduped = 0
         self.cache = global_program_cache()
         self.compilation_cache_dir = enable_persistent_compilation_cache(
             compilation_cache_dir
@@ -220,6 +253,15 @@ class SearchServer:
                 "program_cache": cache,
                 "warm_hit_ratio": cache["hit_ratio"],
                 "compilation_cache_dir": self.compilation_cache_dir,
+                "fleet": {
+                    "enabled": self.fleet,
+                    "max_lanes": self.fleet_max,
+                    "window_s": self.fleet_window_s,
+                    "batches": self._fleet_batches,
+                    "coalesced_lanes": self._fleet_lanes,
+                    "largest_batch": self._fleet_max_seen,
+                    "deduped_lanes": self._fleet_deduped,
+                },
             }
 
     # -- scheduling internals --------------------------------------------------
@@ -257,7 +299,11 @@ class SearchServer:
                 self._finalize(job, q.CANCELLED, release=False)
                 return
             try:
-                self._run_job(job)
+                mates = self._gather_fleet(job)
+                if mates:
+                    self._run_fleet([job] + mates)
+                else:
+                    self._run_job(job)
             except BaseException as e:  # a worker must never die silently
                 job.error = f"{type(e).__name__}: {e}"
                 self._queue.release(job)
@@ -267,7 +313,13 @@ class SearchServer:
         with self._lock:
             return set(self._warm_buckets)
 
-    def _make_callback(self, job: Job, fingerprint: tuple):
+    def _make_callback(self, job: Job, fingerprint: tuple, group=None):
+        """Per-iteration engine hook. ``group`` is the dedup group sharing
+        this run (leader first): a shared lane only stops on cancel when
+        EVERY rider has cancelled — one tenant's cancel must not evict a
+        search that other identical jobs are still waiting on. Preemption
+        keys off the leader alone (a follower occupies no device lane, so
+        evicting the shared run for it would waste everyone's progress)."""
         spec = job.spec
 
         def _on_iteration(report) -> bool | None:
@@ -291,17 +343,18 @@ class SearchServer:
                     if job.ttff is None:
                         job.ttff = time.time() - job.submitted_at
                     self._frame_cond.notify_all()
-            if (
-                job.cancel_requested.is_set()
-                or job.preempt_requested.is_set()
-                or self._stopping
-            ):
+            cancelled = (
+                all(j.cancel_requested.is_set() for j in group)
+                if group
+                else job.cancel_requested.is_set()
+            )
+            if cancelled or job.preempt_requested.is_set() or self._stopping:
                 return True
             return None
 
         return _on_iteration
 
-    def _run_job(self, job: Job) -> None:
+    def _run_job(self, job: Job, group=None) -> None:
         from ..search import equation_search
         from ..utils.checkpoint import options_fingerprint
 
@@ -317,26 +370,7 @@ class SearchServer:
         job.iteration_base = job.iterations_done
 
         fingerprint = options_fingerprint(spec.options)
-        timeout = spec.options.timeout_in_seconds
-        if job.deadline_at is not None:
-            remaining = job.deadline_at - now
-            timeout = remaining if timeout is None else min(timeout, remaining)
-        opts = dataclasses.replace(
-            spec.options,
-            iteration_callback=self._make_callback(job, fingerprint),
-            timeout_in_seconds=timeout,
-            max_evals=(
-                spec.max_evals
-                if spec.max_evals is not None
-                else spec.options.max_evals
-            ),
-            # the server owns persistence: no CSV sidecars, no per-job
-            # checkpoint cadence (preemption snapshots are written here)
-            save_to_file=False,
-            progress=False,
-            checkpoint_every=None,
-            checkpoint_every_seconds=None,
-        )
+        opts = self._lane_options(job, fingerprint, now, group)
         try:
             result = equation_search(
                 spec.X,
@@ -353,6 +387,36 @@ class SearchServer:
             self._finalize(job, q.FAILED, release=False)
             return
 
+        self._complete_lane(job, result, fingerprint)
+
+    def _lane_options(self, job: Job, fingerprint: tuple, now: float, group=None):
+        """The server's per-run Options replacement — shared by the solo and
+        fleet paths so a coalesced job behaves exactly like a solo one."""
+        spec = job.spec
+        timeout = spec.options.timeout_in_seconds
+        if job.deadline_at is not None:
+            remaining = job.deadline_at - now
+            timeout = remaining if timeout is None else min(timeout, remaining)
+        return dataclasses.replace(
+            spec.options,
+            iteration_callback=self._make_callback(job, fingerprint, group),
+            timeout_in_seconds=timeout,
+            max_evals=(
+                spec.max_evals
+                if spec.max_evals is not None
+                else spec.options.max_evals
+            ),
+            # the server owns persistence: no CSV sidecars, no per-job
+            # checkpoint cadence (preemption snapshots are written here)
+            save_to_file=False,
+            progress=False,
+            checkpoint_every=None,
+            checkpoint_every_seconds=None,
+        )
+
+    def _complete_lane(self, job: Job, result, fingerprint: tuple) -> None:
+        """Post-run bookkeeping for one finished search — the identical
+        terminal sequence whether the search ran solo or as a fleet lane."""
         job.result = result
         job.stop_reason = getattr(result, "stop_reason", None)
         self._release_running(job)
@@ -380,6 +444,175 @@ class SearchServer:
             self._finalize(job, q.EXPIRED, release=False)
             return
         self._finalize(job, q.DONE, release=False)
+
+    # -- fleet coalescing ------------------------------------------------------
+    def _gather_fleet(self, lead: Job) -> list[Job]:
+        """Coalescing admission: given a just-acquired lead job, gather up to
+        ``fleet_max - 1`` compatible queued jobs (same shape bucket, no
+        deadline, no resume checkpoint), waiting one admission window for
+        stragglers when the first sweep comes back short. Returns [] when the
+        lead itself must run solo."""
+        if not self.fleet or self._stopping:
+            return []
+        if (
+            lead.deadline_at is not None
+            or lead.resume_path is not None
+            or lead.cancel_requested.is_set()
+        ):
+            # deadline-urgent jobs bypass coalescing (their wall budget must
+            # not be hostage to fleet drain); preemption resumes warm-start
+            # solo (fleet lanes take no saved_state)
+            return []
+        from ..models.device_search import fleet_eligibility
+
+        probe = dataclasses.replace(
+            lead.spec.options,
+            save_to_file=False,
+            checkpoint_every=None,
+            checkpoint_every_seconds=None,
+        )
+        if fleet_eligibility(probe) is not None:
+            return []
+        limit = self.fleet_max - 1
+        mates = self._queue.take_compatible(lead, limit)
+        if len(mates) < limit and self.fleet_window_s > 0:
+            time.sleep(self.fleet_window_s)
+            mates += self._queue.take_compatible(lead, limit - len(mates))
+        return mates
+
+    def _content_key(self, job: Job) -> tuple:
+        """Full search identity: options digest WITH seed, iteration/eval
+        budget, and the dataset bytes. Jobs with equal keys are the SAME
+        deterministic search and share one lane (request collapsing)."""
+        from ..utils.checkpoint import options_fingerprint
+
+        import numpy as np
+
+        spec = job.spec
+        h = hashlib.sha1()
+        h.update(np.ascontiguousarray(spec.X).tobytes())
+        h.update(np.ascontiguousarray(spec.y).tobytes())
+        if spec.weights is not None:
+            h.update(np.ascontiguousarray(spec.weights).tobytes())
+        return (
+            options_fingerprint(spec.options),
+            spec.niterations,
+            spec.max_evals,
+            h.hexdigest(),
+        )
+
+    def _clone_result(self, result):
+        """Per-rider result object for a dedup group: a fresh shell with its
+        OWN hall of fame (what frames/frontier/stop bookkeeping touch) —
+        the decoded populations and dataset arrays are shared read-only
+        across riders (a full deepcopy costs ~10ms/rider and nothing in
+        the serve path mutates them)."""
+        clone = copy.copy(result)
+        clone.hall_of_fame = copy.deepcopy(result.hall_of_fame)
+        return clone
+
+    def _fan_out(self, leader: Job, followers: list[Job], fingerprint) -> None:
+        """Deliver a dedup group's shared result: each follower finishes
+        with a clone of the leader's result (the engine is
+        deterministic, so this IS the result its own run would produce).
+        If the shared run stopped early through no fault of a follower
+        (eviction, failure), live followers go back to the queue."""
+        ok = (
+            leader.result is not None
+            and leader.state != q.FAILED
+            and leader.stop_reason != "callback"
+        )
+        for f in followers:
+            f.started_at = f.started_at or leader.started_at
+            f.iterations_done = max(f.iterations_done, leader.iterations_done)
+            if f.cancel_requested.is_set():
+                self._release_running(f)
+                self._finalize(f, q.CANCELLED, release=False)
+            elif ok:
+                self._complete_lane(f, self._clone_result(leader.result), fingerprint)
+            elif leader.state == q.FAILED:
+                self._release_running(f)
+                f.error = leader.error
+                self._finalize(f, q.FAILED, release=False)
+            else:
+                self._release_running(f)
+                self._queue.resubmit(f)
+
+    def _run_fleet(self, jobs: list[Job]) -> None:
+        """Run coalesced jobs as one fleet. Jobs are first deduplicated by
+        content (dataset + options incl. seed + budget): duplicates ride
+        the leader's lane and fan out deep-copied results. Each unique lane
+        finalizes through the same terminal sequence as a solo run the
+        moment it finishes (``on_lane_done``) — a cancelled/preempted lane
+        leaves the fleet early while the surviving lanes drain unchanged.
+        A batch that collapses to ONE unique search skips the fleet program
+        entirely and runs the warm solo path."""
+        from ..models.device_search import FleetLaneSpec, fleet_search
+        from ..utils.checkpoint import options_fingerprint
+
+        grouped: dict = {}
+        for job in jobs:
+            grouped.setdefault(self._content_key(job), []).append(job)
+        groups = list(grouped.values())
+
+        now = time.time()
+        with self._lock:
+            for job in jobs:
+                self._running[job.id] = job
+            self._fleet_batches += 1
+            self._fleet_lanes += len(jobs)
+            self._fleet_max_seen = max(self._fleet_max_seen, len(jobs))
+            self._fleet_deduped += len(jobs) - len(groups)
+        for job in jobs:
+            job.started_at = job.started_at or now
+            job.iteration_base = job.iterations_done
+
+        if len(groups) == 1:
+            leader, followers = jobs[0], jobs[1:]
+            fp = options_fingerprint(leader.spec.options)
+            self._run_job(leader, group=jobs)
+            self._fan_out(leader, followers, fp)
+            return
+
+        leaders = [g[0] for g in groups]
+        specs, fingerprints = [], []
+        for group in groups:
+            leader = group[0]
+            fp = options_fingerprint(leader.spec.options)
+            fingerprints.append(fp)
+            specs.append(
+                FleetLaneSpec(
+                    X=leader.spec.X,
+                    y=leader.spec.y,
+                    options=self._lane_options(leader, fp, now, group),
+                    weights=leader.spec.weights,
+                    niterations=leader.spec.niterations,
+                    label=leader.id,
+                )
+            )
+        completed = [False] * len(groups)
+
+        def _lane_done(idx: int, result) -> None:
+            completed[idx] = True
+            self._complete_lane(leaders[idx], result, fingerprints[idx])
+            self._fan_out(leaders[idx], groups[idx][1:], fingerprints[idx])
+
+        try:
+            fleet_search(
+                specs,
+                on_lane_done=_lane_done,
+                coalesce_wait_s=self.fleet_window_s,
+                lane_bucket=self.fleet_max,
+            )
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"
+            for flag, group in zip(completed, groups):
+                if flag:
+                    continue
+                for job in group:
+                    self._release_running(job)
+                    job.error = err
+                    self._finalize(job, q.FAILED, release=False)
 
     def _push_final_frame(self, job: Job, result, fingerprint: tuple) -> None:
         from ..utils.checkpoint import dump_frontier_bytes

@@ -1,0 +1,471 @@
+"""Fleet engine (round 13): N concurrent searches vmapped into one
+megaprogram, coalesced by the serve layer.
+
+Bitwise contracts pinned here:
+
+- a fleet of 1 reproduces ``equation_search`` exactly (same seed, same
+  frontier bit-for-bit, same eval count);
+- a mixed-row-count fleet reproduces, per lane, the SOLO run on that lane's
+  padded dataset (``pad_rows_np`` row bucket + explicit weights) — padding
+  and lane batching change nothing but the dispatch count;
+- the Pallas loss/grad kernels are bitwise-invariant under fleet row
+  padding itself (padded-to-bucket == unpadded), because the padded R lands
+  in the same 8*C_TILE tile bucket and pad rows carry weight 0 (slow-marked:
+  interpret mode emulates the kernel grid serially);
+- a fleet of N costs <=2 device dispatches per iteration — the same
+  invariant the solo fused loop pins in test_fused_iteration.py.
+
+Plus the serve-side admission pieces: the seed-agnostic bucket digest,
+``JobQueue.take_compatible`` filtering, SR_QUEUE_AGE_S head-of-line aging,
+the ProgramCache fleet/solo counter rollup, and end-to-end coalescing on a
+running ``SearchServer(fleet=True)``.
+
+The engine tests reuse the canonical tiny bucket from test_device_search.py
+so solo programs are warm in a full suite run; each distinct fleet width L
+still compiles its own vmapped program once.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options, equation_search
+from symbolicregression_jl_tpu.models import device_search as ds
+from symbolicregression_jl_tpu.models.device_search import (
+    FleetLaneSpec,
+    fleet_eligibility,
+    fleet_search,
+)
+from symbolicregression_jl_tpu.ops.scoring import pad_rows_np
+
+
+def _problem(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _sig(res):
+    """Bitwise frontier signature: float equality on losses IS bit equality
+    (the engines never emit NaN losses into the frontier)."""
+    return [(m.complexity, m.loss, str(m.tree)) for m in res.pareto_frontier]
+
+
+# -- pad_rows_np -------------------------------------------------------------
+
+
+def test_pad_rows_np_layout():
+    X, y = _problem(n=60)
+    Xp, yp, wp = pad_rows_np(X, y, None, 100)
+    assert Xp.shape == (2, 100) and yp.shape == (100,) and wp.shape == (100,)
+    np.testing.assert_array_equal(Xp[:, :60], X)
+    np.testing.assert_array_equal(yp[:60], y)
+    # pad rows replicate row 0 (finite wherever row 0 is) with weight 0
+    np.testing.assert_array_equal(Xp[:, 60:], np.repeat(X[:, :1], 40, axis=1))
+    np.testing.assert_array_equal(yp[60:], np.full(40, y[0]))
+    np.testing.assert_array_equal(wp, np.r_[np.ones(60), np.zeros(40)].astype(y.dtype))
+    # explicit weights pass through; no-op bucket returns inputs unchanged
+    w = np.linspace(0.5, 2.0, 60).astype(np.float32)
+    _, _, wp2 = pad_rows_np(X, y, w, 100)
+    np.testing.assert_array_equal(wp2[:60], w)
+    X3, y3, w3 = pad_rows_np(X, y, w, 60)
+    np.testing.assert_array_equal(w3, w)
+    with pytest.raises(ValueError):
+        pad_rows_np(X, y, None, 59)
+
+
+# -- Pallas kernels bitwise-invariant under fleet row padding ----------------
+# (slow: interpret mode emulates the kernel grid serially on the host; CI
+# runs the interpret files directly, tier-1 skips them)
+
+
+@pytest.fixture
+def _interpret(monkeypatch):
+    monkeypatch.setenv("SR_PALLAS_INTERPRET", "1")
+
+
+@pytest.mark.slow
+def test_padded_loss_kernel_bitwise(_interpret):
+    """Fused loss kernel: padding 60 rows to a 100-row fleet bucket leaves
+    every tree's loss bit-identical — same 8*C_TILE tile bucket, pad rows
+    masked by zero weight, identical reduction order."""
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.interp_pallas import make_pallas_loss_fn
+
+    opts = _opts()
+    X, y = _problem(n=60)
+    rng = np.random.default_rng(1)
+    flat = flatten_trees(Population.random_trees(32, opts, 2, rng), opts.max_nodes)
+    Xp, yp, wp = pad_rows_np(X, y, None, 100)
+    a = np.asarray(make_pallas_loss_fn(X, y, None, opts.operators, opts.loss)(flat))
+    b = np.asarray(make_pallas_loss_fn(Xp, yp, wp, opts.operators, opts.loss)(flat))
+    assert (np.isfinite(a) == np.isfinite(b)).all()
+    fin = np.isfinite(a)
+    assert fin.any()
+    np.testing.assert_array_equal(a[fin], b[fin])
+
+
+@pytest.mark.slow
+def test_padded_grad_kernel_bitwise(_interpret):
+    """The custom_vjp loss+grad kernel: constant gradients are bit-identical
+    under fleet row padding too (const-opt trajectories cannot diverge)."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.population import Population
+    from symbolicregression_jl_tpu.ops import flatten_trees
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        make_pallas_diff_loss_fn,
+        pack_flat_fused,
+    )
+
+    opts = _opts()
+    X, y = _problem(n=60)
+    rng = np.random.default_rng(2)
+    flat = flatten_trees(Population.random_trees(32, opts, 2, rng), opts.max_nodes)
+    N = flat.kind.shape[1]
+    ints = jnp.asarray(pack_flat_fused(flat, opts.operators)[0])
+    v0 = jnp.asarray(flat.val, jnp.float32)
+
+    def run(Xa, ya, wa):
+        dfn = make_pallas_diff_loss_fn(Xa, ya, wa, opts.operators, opts.loss)
+        loss, pull = jax.vjp(lambda v: dfn(ints, v, N), v0)
+        (g,) = pull(jnp.ones_like(loss))
+        return np.asarray(loss), np.asarray(g)
+
+    la, ga = run(X, y, None)
+    lb, gb = run(*pad_rows_np(X, y, None, 100))
+    assert (np.isfinite(la) == np.isfinite(lb)).all()
+    fin = np.isfinite(la)
+    assert fin.any()
+    np.testing.assert_array_equal(la[fin], lb[fin])
+    np.testing.assert_array_equal(ga[fin], gb[fin])
+
+
+# -- engine: fleet_search bitwise vs solo ------------------------------------
+#
+# The engine/server tests below each compile 35-45s AOT programs on CPU, so
+# they are slow-marked out of tier-1; CI runs this file directly (see the
+# fleet step in .github/workflows/ci.yml).
+
+
+def test_fleet_eligibility():
+    assert fleet_eligibility(_opts()) is None
+    assert fleet_eligibility(_opts(scheduler="lockstep")) is not None
+    assert fleet_eligibility(_opts(populations=8)) is not None  # would shard
+
+
+@pytest.mark.slow
+def test_fleet_of_one_bitwise_vs_solo():
+    """L=1 A/B: the fleet driver is the solo driver plus a vmap axis — one
+    lane must reproduce equation_search bit-for-bit, evals included."""
+    X, y = _problem()
+    solo = equation_search(X, y, options=_opts(), niterations=2, verbosity=0)
+    (fleet,) = fleet_search(
+        [FleetLaneSpec(X=X, y=y, options=_opts(), niterations=2)]
+    )
+    assert _sig(fleet) == _sig(solo)
+    assert fleet.num_evals == solo.num_evals
+
+
+@pytest.mark.slow
+def test_fleet_mixed_rows_bitwise_vs_padded_solo():
+    """Mixed row counts (100 + 60 rows) in one fleet: every lane reproduces
+    the solo run on its padded dataset. The 60-row lane's engine dataset is
+    pad_rows_np(..., 100); mixed-n also forces explicit ones-weights on the
+    full-width lane (uniform ScoreData pytree across lanes), so its solo
+    reference carries them too."""
+    Xa, ya = _problem(n=100, seed=0)
+    Xb, yb = _problem(n=60, seed=1)
+    results = fleet_search(
+        [
+            FleetLaneSpec(X=Xa, y=ya, options=_opts(seed=0), niterations=2),
+            FleetLaneSpec(X=Xb, y=yb, options=_opts(seed=7), niterations=2),
+        ]
+    )
+    wa = np.ones(100, ya.dtype)
+    solo_a = equation_search(
+        Xa, ya, weights=wa, options=_opts(seed=0), niterations=2, verbosity=0
+    )
+    Xp, yp, wp = pad_rows_np(Xb, yb, None, 100)
+    solo_b = equation_search(
+        Xp, yp, weights=wp, options=_opts(seed=7), niterations=2, verbosity=0
+    )
+    assert _sig(results[0]) == _sig(solo_a)
+    assert _sig(results[1]) == _sig(solo_b)
+    assert results[0].num_evals == solo_a.num_evals
+    assert results[1].num_evals == solo_b.num_evals
+
+
+@pytest.mark.slow
+def test_fleet_lane_bucket_pads_bitwise():
+    """lane_bucket pads the fleet axis with inert lanes so every batch size
+    shares one compiled program — a single real lane padded to width 2 must
+    still be bit-identical to its solo run (the W=2 program is warm from
+    the mixed test, so no extra compile here)."""
+    Xa, ya = _problem(n=100, seed=0)
+    wa = np.ones(100, ya.dtype)
+    (fleet,) = fleet_search(
+        [
+            FleetLaneSpec(
+                X=Xa, y=ya, weights=wa, options=_opts(seed=0), niterations=2
+            )
+        ],
+        lane_bucket=2,
+    )
+    solo = equation_search(
+        Xa, ya, weights=wa, options=_opts(seed=0), niterations=2, verbosity=0
+    )
+    assert _sig(fleet) == _sig(solo)
+    assert fleet.num_evals == solo.num_evals
+
+
+@pytest.mark.slow
+def test_fleet_dispatch_count_per_iteration(monkeypatch):
+    """A fleet of N still costs <=2 device dispatches per iteration: the
+    vmapped megaprogram plus one stacked readback (same datasets as the
+    mixed test, so the L=2 program is warm in a full run)."""
+    calls = []
+    monkeypatch.setattr(ds, "_DISPATCH_HOOK", calls.append)
+    Xa, ya = _problem(n=100, seed=0)
+    Xb, yb = _problem(n=60, seed=1)
+    fleet_search(
+        [
+            FleetLaneSpec(X=Xa, y=ya, options=_opts(seed=0), niterations=3),
+            FleetLaneSpec(X=Xb, y=yb, options=_opts(seed=7), niterations=3),
+        ]
+    )
+    counts = {name: calls.count(name) for name in set(calls)}
+    assert set(counts) <= {"fused_iter", "readback"}, counts
+    assert counts["fused_iter"] == 3
+    assert counts["readback"] == 3
+
+
+@pytest.mark.slow
+def test_fleet_mixed_niterations_freezes_finished_lane():
+    """A lane whose budget ends early freezes (masked lanes idle) while the
+    other keeps evolving — the short lane still matches its solo run."""
+    Xa, ya = _problem(n=100, seed=0)
+    results = fleet_search(
+        [
+            FleetLaneSpec(X=Xa, y=ya, options=_opts(seed=0), niterations=1),
+            FleetLaneSpec(X=Xa, y=ya, options=_opts(seed=3), niterations=3),
+        ]
+    )
+    solo_short = equation_search(
+        Xa, ya, options=_opts(seed=0), niterations=1, verbosity=0
+    )
+    assert _sig(results[0]) == _sig(solo_short)
+    assert results[0].num_evals == solo_short.num_evals
+
+
+# -- serve: seed-agnostic bucket, take_compatible, aging ---------------------
+
+
+def test_options_digest_ignores_seed():
+    from symbolicregression_jl_tpu.serve import options_digest, shape_bucket
+
+    X, y = _problem()
+    assert options_digest(_opts(seed=0)) == options_digest(_opts(seed=99))
+    assert shape_bucket(X, y, None, _opts(seed=0)) == shape_bucket(
+        X, y, None, _opts(seed=99)
+    )
+    assert options_digest(_opts()) != options_digest(_opts(maxsize=12))
+
+
+def _job(q, X, y, seed=0, **kw):
+    from symbolicregression_jl_tpu.serve import Job, JobSpec
+
+    spec = JobSpec(X=X, y=y, options=_opts(seed=seed), niterations=1, **kw)
+    job = Job(f"j{q._seq}", spec, q._seq)
+    q._seq += 1
+    q.submit(job)
+    return job
+
+
+class _Q:
+    """JobQueue plus a local seq counter for hand-built jobs."""
+
+    def __new__(cls):
+        from symbolicregression_jl_tpu.serve import JobQueue
+
+        q = JobQueue(default_quota=8)
+        q._seq = 0
+        return q
+
+
+def test_take_compatible_filters_and_charges_quota():
+    X, y = _problem()
+    X2, y2 = _problem(n=60, seed=1)
+    q = _Q()
+    lead = _job(q, X, y, seed=0)
+    lead = q.acquire(timeout=0)
+    mate = _job(q, X, y, seed=1)  # same bucket, different seed -> taken
+    other_shape = _job(q, X2, y2)  # different bucket -> left queued
+    deadline = _job(q, X, y, seed=2, deadline_seconds=3600)  # solo -> left
+    cancelled = _job(q, X, y, seed=3)
+    cancelled.cancel_requested.set()
+    taken = q.take_compatible(lead, limit=8)
+    assert taken == [mate]
+    from symbolicregression_jl_tpu.serve import RUNNING
+
+    assert mate.state == RUNNING
+    assert len(q) == 3  # other_shape + deadline + cancelled still pending
+    # quota was charged for the mate: default tenant now runs lead + mate
+    assert q._running_by_tenant["default"] == 2
+    q.release(lead)
+    q.release(mate)
+
+
+def test_take_compatible_respects_limit_and_fifo():
+    X, y = _problem()
+    q = _Q()
+    _job(q, X, y, seed=0)
+    lead = q.acquire(timeout=0)
+    mates = [_job(q, X, y, seed=i) for i in range(1, 5)]
+    taken = q.take_compatible(lead, limit=2)
+    assert taken == mates[:2]  # FIFO by seq
+    assert len(q) == 2
+
+
+def test_queue_aging_promotes_cold_bucket_job(monkeypatch):
+    """A cold-bucket job queued past SR_QUEUE_AGE_S competes as warm: FIFO
+    order then beats the later warm-bucket submission."""
+    monkeypatch.setenv("SR_QUEUE_AGE_S", "30")
+    X, y = _problem()
+    X2, y2 = _problem(n=60, seed=1)
+    q = _Q()
+    cold = _job(q, X2, y2)  # earlier seq, cold bucket
+    warm = _job(q, X, y)
+    warm_buckets = {warm.bucket}
+    got = q.acquire(warm_buckets=warm_buckets, timeout=0)
+    assert got is warm  # fresh: warmth outranks FIFO
+    q.release(warm)
+    q.resubmit(warm)
+    cold.submitted_at -= 31  # age past the threshold
+    got = q.acquire(warm_buckets=warm_buckets, timeout=0)
+    assert got is cold  # aged: warmth term equalized, seq decides
+    q.release(cold)
+
+
+def test_queue_aging_disabled(monkeypatch):
+    monkeypatch.setenv("SR_QUEUE_AGE_S", "0")
+    X, y = _problem()
+    X2, y2 = _problem(n=60, seed=1)
+    q = _Q()
+    cold = _job(q, X2, y2)
+    warm = _job(q, X, y)
+    cold.submitted_at -= 3600
+    got = q.acquire(warm_buckets={warm.bucket}, timeout=0)
+    assert got is warm  # aging off: warm bucket always preferred
+    q.release(warm)
+
+
+# -- program cache: fleet/solo rollup ----------------------------------------
+
+
+def test_program_cache_fleet_rollup():
+    from symbolicregression_jl_tpu.serve.program_cache import ProgramCache
+
+    cache = ProgramCache(capacity=8)
+    cache.put("aot", "s1", object())
+    cache.get("aot", "s1")
+    cache.get("aot", "s2")  # solo miss
+    cache.put("fleet_aot", "f1", object())
+    cache.get("fleet_aot", "f1")
+    cache.get("fleet_aot", "f2")  # fleet miss
+    cache.get("fleet_rb", "r1")  # fleet miss
+    st = cache.stats()
+    assert st["fleet"] == {
+        "hits": 1,
+        "misses": 2,
+        "solo_hits": 1,
+        "solo_misses": 1,
+    }
+
+
+# -- serve: end-to-end coalescing --------------------------------------------
+
+
+@pytest.mark.slow
+def test_server_coalesces_same_bucket_jobs():
+    """Two same-bucket jobs (seeds differ) submitted back-to-back must run
+    as ONE fleet batch (the admission window covers the submit gap); each
+    result matches its solo run bit-for-bit, and the frame stream is
+    demuxed per job."""
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
+
+    X, y = _problem()
+    srv = SearchServer(
+        max_concurrency=1, fleet=True, fleet_max=2, fleet_window_s=2.0
+    ).start()
+    try:
+        ids = [
+            srv.submit(JobSpec(X=X, y=y, options=_opts(seed=s), niterations=1))
+            for s in (0, 11)
+        ]
+        jobs = [srv.wait(i, timeout=900) for i in ids]
+        assert all(j.state == DONE for j in jobs), [j.summary() for j in jobs]
+        st = srv.stats()["fleet"]
+        assert st["batches"] == 1 and st["coalesced_lanes"] == 2, st
+        assert st["deduped_lanes"] == 0, st  # distinct seeds never collapse
+        for j, seed in zip(jobs, (0, 11)):
+            solo = equation_search(
+                X, y, options=_opts(seed=seed), niterations=1, verbosity=0
+            )
+            assert _sig(j.result) == _sig(solo)
+            assert len(srv.frames(j.id)) > 0
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_server_dedups_identical_jobs():
+    """Identical concurrent jobs (same dataset, options, seed, budget)
+    collapse onto ONE lane: the engine is deterministic, so every rider
+    receives the result its own run would have produced — one coalesced
+    batch, one actual search, per-job frames and DONE states."""
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
+
+    X, y = _problem()
+    solo = equation_search(X, y, options=_opts(), niterations=1, verbosity=0)
+    srv = SearchServer(
+        max_concurrency=1, fleet=True, fleet_max=4, fleet_window_s=2.0,
+        default_quota=8,
+    ).start()
+    try:
+        ids = [
+            srv.submit(JobSpec(X=X, y=y, options=_opts(), niterations=1))
+            for _ in range(4)
+        ]
+        jobs = [srv.wait(i, timeout=900) for i in ids]
+        assert all(j.state == DONE for j in jobs), [j.summary() for j in jobs]
+        st = srv.stats()["fleet"]
+        assert st["batches"] == 1, st
+        assert st["coalesced_lanes"] == 4, st
+        assert st["deduped_lanes"] == 3, st
+        sigs = [_sig(j.result) for j in jobs]
+        assert all(s == _sig(solo) for s in sigs), "rider result != solo"
+        # riders get their OWN result objects (no aliasing across tenants)
+        assert len({id(j.result) for j in jobs}) == 4
+        for j in jobs:
+            assert len(srv.frames(j.id)) > 0
+    finally:
+        srv.shutdown()
