@@ -41,6 +41,7 @@ from .ops.losses import (
     L2HingeLoss,
     L2MarginLoss,
     LogCoshLoss,
+    LogisticLoss,
     LogitDistLoss,
     LogitMarginLoss,
     LPDistLoss,
@@ -51,6 +52,18 @@ from .ops.losses import (
     SigmoidLoss,
     SmoothedL1HingeLoss,
     ZeroOneLoss,
+    loss_zoo,
+    make_loss,
+)
+# streaming/online runtime (round 14): live row swaps over a resident fleet
+# lane, drift-aware frontiers, and fleet-batched multi-target search (the
+# engine-level counterpart of the per-output solo loop in equation_search)
+from .stream import (
+    DriftConfig,
+    DriftDetector,
+    MultitargetSearch,
+    StreamSession,
+    multitarget_search,
 )
 from .analysis.ir_verify import FlatIRError, verify_flat_trees
 from .parallel.distributed import PeerLossError
@@ -116,5 +129,13 @@ __all__ = [
     "SigmoidLoss",
     "SmoothedL1HingeLoss",
     "ZeroOneLoss",
+    "LogisticLoss",
+    "loss_zoo",
+    "make_loss",
+    "DriftConfig",
+    "DriftDetector",
+    "MultitargetSearch",
+    "StreamSession",
+    "multitarget_search",
     "__version__",
 ]

@@ -2932,7 +2932,13 @@ class FleetLaneSpec:
     ``equation_search(X, y, options=...)`` call would (same
     ``np.random.default_rng(seed)`` stream for initial trees + engine seed),
     so a lane's final frontier is bit-identical to the same search run solo
-    — pinned by tests/test_fleet.py."""
+    — pinned by tests/test_fleet.py.
+
+    ``init_trees``/``init_hof`` warm-start the lane (stream epochs: a
+    session whose row bucket overflowed restarts its lane from the previous
+    epoch's populations and KEEPS its live hall of fame). A warm-started
+    lane is a continuation, not a replay — the solo-bitwise guarantee above
+    applies only to cold lanes."""
 
     X: object
     y: object
@@ -2940,6 +2946,8 @@ class FleetLaneSpec:
     weights: object = None
     niterations: int = 10
     label: str = ""
+    init_trees: object = None  # exactly populations*population_size trees
+    init_hof: object = None  # a live HallOfFame the lane adopts (not copied)
 
 
 def fleet_eligibility(options: Options) -> str | None:
@@ -3104,6 +3112,8 @@ class _FleetLane:
             or not use_pallas
             or (options.should_optimize_constants and not use_pallas_grad)
         )
+        self.need_raw = need_raw
+        self.eng_dt = eng_dt
         self.score_fn, self.score_data = _make_score_fn(
             Xe, ye, we, options, use_pallas, ds_key=ds_key, norm=norm_val,
             need_raw=need_raw,
@@ -3152,16 +3162,28 @@ class _FleetLane:
             and "no_simplify" not in os.environ.get("SR_ABLATE", "").split(",")
         )
         self.early_stop = options.early_stop_fn()
-        self.hof = HallOfFame(options.maxsize)
+        self.hof = (
+            spec.init_hof
+            if spec.init_hof is not None
+            else HallOfFame(options.maxsize)
+        )
         self.device_evals = 0.0
         self.host_evals = 0.0
         self.num_evals = 0.0
         self.stop_reason: str | None = None
 
         # --- initial populations -> scored device EvoState (solo build_state)
-        init_trees = Population.random_trees(
-            I * P, options, dataset.n_features, rng
-        )
+        if spec.init_trees is not None:
+            init_trees = list(spec.init_trees)
+            if len(init_trees) != I * P:
+                raise ValueError(
+                    "init_trees must carry populations*population_size="
+                    f"{I * P} trees (got {len(init_trees)})"
+                )
+        else:
+            init_trees = Population.random_trees(
+                I * P, options, dataset.n_features, rng
+            )
         seed = int(rng.integers(0, 2**31 - 1))
         N = options.max_nodes
         bflat = flatten_trees(init_trees, N, dtype=eng_dt)
@@ -3184,6 +3206,80 @@ class _FleetLane:
         self.state = st._replace(
             loss=loss_dev, score=_score_of(loss_dev, comp, cfg)
         )
+
+    def rebuild_score_data(self, X, y, weights) -> "tuple[ScoreData, Dataset]":
+        """Same-shape ScoreData for a live row swap (the stream runtime's
+        between-iteration data update).
+
+        Mirrors ``__init__``'s host arithmetic exactly — engine-dtype cast,
+        weighted baseline loss, the baseline->norm clamp — so pushing the
+        IDENTICAL buffer back rebuilds bit-identical device values. Bypasses
+        the score_data LRU on purpose: a streaming session's per-push
+        buffers would churn the cache without ever being re-requested.
+        Shapes (and weight presence) must match the lane's buffers; the
+        same-shape constraint is what makes the swap recompile-free — the
+        dataset travels as a traced, NON-donated argument of the fleet
+        program, so only a new shape forces a new executable.
+
+        Returns ``(score_data, dataset)``: the swap payload plus the host
+        Dataset the lane's final SearchResult should report."""
+        X = np.asarray(X)
+        y = np.asarray(y)
+        w = None if weights is None else np.asarray(weights)
+        if (
+            X.shape != self.dataset.X.shape
+            or y.shape != self.dataset.y.shape
+            or (w is None) != (self.dataset.weights is None)
+        ):
+            raise ValueError(
+                f"row swap must keep the lane's shapes: X {self.dataset.X.shape}"
+                f"/y {self.dataset.y.shape}/weights "
+                f"{self.dataset.weights is not None} vs swapped X {X.shape}"
+                f"/y {y.shape}/weights {w is not None}"
+            )
+        ds = Dataset(X, y, weights=w)
+        Xe = ds.X.astype(self.eng_dt)
+        ye = ds.y.astype(self.eng_dt)
+        we = None if ds.weights is None else ds.weights.astype(self.eng_dt)
+        elem = np.asarray(
+            self.options.loss(np.full_like(ye, ds.avg_y), ye), np.float64
+        )
+        if we is not None:
+            bl = float((elem * we).sum() / we.sum())
+        else:
+            bl = float(elem.mean())
+        use_baseline = bool(np.isfinite(bl))
+        ds.baseline_loss = bl if use_baseline else 1.0
+        ds.use_baseline = use_baseline
+        norm_val = bl if (use_baseline and bl >= 0.01) else 0.01
+        data = _make_score_data(
+            Xe, ye, we, self.use_pallas, norm=norm_val, need_raw=self.need_raw
+        )
+        return data, ds
+
+
+@dataclasses.dataclass
+class LaneDataUpdate:
+    """One lane's between-iteration data swap, returned by a
+    ``fleet_search`` ``data_update_hook``: a same-shape ScoreData (from
+    ``_FleetLane.rebuild_score_data``), the replacement host Dataset the
+    final SearchResult reports, and an optional parsimony-frequency reset —
+    the drift response that forgets the complexity histogram learned on the
+    old data (the per-lane ``freq`` row resets to the ``init_state``
+    uniform)."""
+
+    score_data: object = None
+    dataset: object = None
+    reset_freq: bool = False
+
+
+def _set_lane_slice(tree_f, l, new_tree):
+    """Write one lane's slice of a stacked [Lb, ...] pytree."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a, nd: a.at[l].set(nd), tree_f, new_tree
+    )
 
 
 def _fleet_dummy_pool(ecfg: EvoConfig):
@@ -3209,6 +3305,8 @@ def fleet_search(
     coalesce_wait_s: float = 0.0,
     on_lane_done=None,
     lane_bucket: int | None = None,
+    data_update_hook=None,
+    on_lanes_ready=None,
 ):
     """Run N compatible single-output searches as ONE vmapped megaprogram
     per iteration. Returns ``[SearchResult]`` in spec order.
@@ -3229,7 +3327,18 @@ def fleet_search(
 
     ``on_lane_done(idx, result)`` fires as each lane finalizes — the serve
     layer uses it to complete jobs without waiting for the whole fleet.
-    ``coalesce_wait_s`` is bookkeeping only (profiler counter)."""
+    ``coalesce_wait_s`` is bookkeeping only (profiler counter).
+
+    ``data_update_hook(it)`` (stream runtime) runs at the TOP of each
+    iteration, before the fused step, with the 0-based iteration index; it
+    may return ``{lane_idx: LaneDataUpdate}`` to swap lanes' datasets
+    between iterations. The stacked dataset is a traced, non-donated
+    program argument, so a same-shape swap reuses the resident executables
+    with ZERO recompiles (pinned by tests/test_stream.py); a structure
+    change raises instead of silently retracing. ``on_lanes_ready(lanes)``
+    fires once after lane construction, handing the caller the live
+    ``_FleetLane`` objects (the stream session uses lane.rebuild_score_data
+    and the lane's warm score programs for drift probes/rescoring)."""
     import jax
     import jax.numpy as jnp
 
@@ -3308,6 +3417,8 @@ def fleet_search(
     )
     for lane in lanes:
         lane.state = None  # the stacked copy is authoritative now
+    if on_lanes_ready is not None:
+        on_lanes_ready(lanes)
 
     active = [lane.nit > 0 for lane in lanes] + [False] * pad
     active_dev = jnp.asarray(np.asarray(active))
@@ -3459,6 +3570,34 @@ def fleet_search(
     for it in range(nit_max):
         if not any(active):
             break
+        if data_update_hook is not None:
+            updates = data_update_hook(it)
+            for l, upd in (updates or {}).items():
+                lane = lanes[l]
+                if upd.score_data is not None:
+                    new_d = upd.score_data
+                    if jax.tree_util.tree_structure(
+                        new_d
+                    ) != jax.tree_util.tree_structure(lane.score_data):
+                        # structural equality is the zero-recompile contract:
+                        # a mismatched pytree (weights appearing where none
+                        # existed, raw fields toggling) would silently
+                        # retrace the whole fleet program on next dispatch
+                        raise ValueError(
+                            f"lane {l} data update changes the ScoreData "
+                            "structure; rebuild it with "
+                            "_FleetLane.rebuild_score_data"
+                        )
+                    data_f = _set_lane_slice(data_f, l, new_d)
+                    # score_call reads the attribute at call time, so the
+                    # simplify-pool rescoring sees the swapped data too
+                    lane.score_data = new_d
+                if upd.dataset is not None:
+                    lane.dataset = upd.dataset
+                if upd.reset_freq:
+                    state_f = state_f._replace(
+                        freq=state_f.freq.at[l].set(1.0)
+                    )
         with prof.stage("fused_iter"):
             _count_dispatch("fused_iter")
             state_f = fused_step(state_f, active_dev, data_f)

@@ -9,11 +9,15 @@ with targets in {-1, +1}, following the same convention.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax.numpy as jnp
 
-__all__ = ["LOSSES", "resolve_loss", "weighted_mean_loss", "L2DistLoss"]
+__all__ = [
+    "LOSSES", "resolve_loss", "weighted_mean_loss", "L2DistLoss",
+    "LogisticLoss", "make_loss", "loss_zoo",
+]
 
 
 # -- distance-based losses: f(difference) ------------------------------------
@@ -100,6 +104,18 @@ def QuantileLoss(tau: float = 0.5) -> Callable:
     return loss
 
 
+def LogisticLoss(pred, target):
+    """Binary cross-entropy on LOGITS with targets in {0, 1} — the
+    classification-SR head: the evolved expression is a decision function
+    whose sign separates the classes, and sigmoid(pred) is the class-1
+    probability. Computed in the overflow-safe form
+    ``max(p, 0) - p*t + log1p(exp(-|p|))`` (the naive
+    ``-t*log(sigmoid(p)) - (1-t)*log(1-sigmoid(p))`` saturates to inf at
+    |p| ~ 90 in f32 and its gradient dies long before that)."""
+    a = jnp.abs(pred)
+    return jnp.maximum(pred, 0.0) - pred * target + jnp.log1p(jnp.exp(-a))
+
+
 # -- margin-based losses: f(agreement = pred * target), target in {-1, 1} ----
 
 
@@ -151,6 +167,7 @@ def DWDMarginLoss(q: float = 1.0) -> Callable:
 LOSSES: dict[str, Callable] = {
     "L2DistLoss": L2DistLoss,
     "L1DistLoss": L1DistLoss,
+    "LogisticLoss": LogisticLoss,
     "LogitDistLoss": LogitDistLoss,
     "LogCoshLoss": LogCoshLoss,
     "L2ComplexDistLoss": L2ComplexDistLoss,
@@ -193,6 +210,16 @@ _FACTORIES = {
 }
 
 
+@functools.lru_cache(maxsize=None)
+def _cached_factory(name: str, arg: float) -> Callable:
+    """Memoized parameterized-loss instantiation: callable IDENTITY keys the
+    compiled-program caches downstream (score-fn memoization, the Pallas
+    kernel loss UID), so two Options built from the same "HuberLoss(0.5)"
+    spec must share ONE closure — a fresh closure per resolve would recompile
+    every engine program for an identical loss."""
+    return _FACTORIES[name](arg)
+
+
 def resolve_loss(spec) -> Callable:
     """name | callable | None -> elementwise loss fn(pred, target).
     Default: L2 (reference default, /root/reference/src/Options.jl:534-535)."""
@@ -207,9 +234,76 @@ def resolve_loss(spec) -> Callable:
         if "(" in spec and spec.endswith(")"):
             name, argstr = spec.split("(", 1)
             if name in _FACTORIES:
-                return _FACTORIES[name](float(argstr[:-1]))
+                return _cached_factory(name, float(argstr[:-1]))
         raise KeyError(f"unknown loss {spec!r}; known: {sorted(LOSSES)}")
     raise TypeError(f"cannot interpret loss spec {spec!r}")
+
+
+# -- the loss zoo: task-level heads over the elementwise losses ---------------
+#
+# ``make_loss`` is the scenario-facing factory (streaming sessions, the
+# serve layer, MultitargetSearch): short task names instead of
+# LossFunctions.jl class names, memoized instantiation so equal specs share
+# one callable (and therefore every compiled program keyed on it), and
+# static Pallas coverage metadata. Every zoo head is closed-form
+# elementwise jnp, so it traces through the scan interpreter, the batched
+# scorer, const-opt gradients, AND the fused Pallas loss/grad kernels
+# (which take the loss as a generic traced callable — parity pinned by
+# tests/test_pallas_interpret.py).
+
+_ZOO: dict[str, tuple] = {
+    # name -> (factory(*params) -> loss, param names, defaults, task)
+    "l2": (lambda: L2DistLoss, (), (), "regression"),
+    "l1": (lambda: L1DistLoss, (), (), "robust regression"),
+    "huber": (HuberLoss, ("delta",), (1.0,), "robust regression"),
+    "quantile": (QuantileLoss, ("tau",), (0.5,), "quantile regression"),
+    "pinball": (QuantileLoss, ("tau",), (0.5,), "quantile regression"),
+    "logistic": (lambda: LogisticLoss, (), (), "binary classification"),
+    "logcosh": (lambda: LogCoshLoss, (), (), "robust regression"),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _zoo_instance(key: str, args: tuple) -> Callable:
+    return _ZOO[key][0](*args)
+
+
+def make_loss(name: str, *params: float) -> Callable:
+    """Loss-zoo factory: ``make_loss("quantile", 0.9)`` ->  elementwise loss.
+
+    Memoized per NORMALIZED (name, params) — aliases and omitted defaults
+    collapse onto one closure (``make_loss("pinball") is
+    make_loss("quantile", 0.5)``): callable identity keys the score-fn and
+    Pallas-kernel caches, so every search/session built from an equal spec
+    reuses the same compiled programs."""
+    key = name.lower()
+    if key == "pinball":  # alias — must share quantile's memoized closures
+        key = "quantile"
+    if key not in _ZOO:
+        raise KeyError(f"unknown zoo loss {name!r}; known: {sorted(_ZOO)}")
+    _, pnames, defaults, _ = _ZOO[key]
+    if len(params) > len(pnames):
+        raise TypeError(
+            f"{name} takes at most {len(pnames)} parameter(s) {pnames}"
+        )
+    args = tuple(float(p) for p in params) + defaults[len(params):]
+    return _zoo_instance(key, args)
+
+
+def loss_zoo() -> dict[str, dict]:
+    """Metadata for the zoo heads: parameters, task, and Pallas kernel
+    status. Coverage is static truth (every head is closed-form elementwise
+    jnp, which the fused loss/grad kernels trace generically); the claim is
+    pinned numerically by tests/test_pallas_interpret.py."""
+    return {
+        name: {
+            "params": dict(zip(pnames, defaults)),
+            "task": task,
+            "pallas": True,
+            "pallas_grad": True,
+        }
+        for name, (_, pnames, defaults, task) in _ZOO.items()
+    }
 
 
 def weighted_mean_loss(elem, weights=None):

@@ -108,7 +108,19 @@ def shape_bucket(X, y, weights, options) -> tuple:
 class JobSpec:
     """What a tenant submits. ``options.scheduler`` picks the engine;
     ``deadline_seconds`` is a wall budget measured from SUBMIT (covering
-    queue wait — an expired job is terminal even if it never ran)."""
+    queue wait — an expired job is terminal even if it never ran).
+    ``deadline_seconds=None`` means **never expires**: queue-side sweeps and
+    mid-run checks alike must skip deadline-less jobs (pinned by
+    tests/test_serve.py).
+
+    ``kind="subscription"`` is the streaming job type: a deadline-less
+    search over a live dataset (``stream.StreamSession``) that emits
+    format-2 frontier frames indefinitely until the client cancels.
+    Subscriptions are necessarily deadline-less and non-preemptible (there
+    is no finite remaining-iterations budget for a preemption checkpoint to
+    resume over), and never coalesce into fleets (each owns its own
+    long-lived lane). ``stream_config`` passes StreamSession knobs through
+    (row_bucket, window, drift=..., ...); ``niterations`` is ignored."""
 
     X: Any
     y: Any
@@ -122,6 +134,8 @@ class JobSpec:
     preemptible: bool = True
     stream_every: int = 1  # frontier frame cadence, in iterations
     label: str = ""
+    kind: str = "search"  # "search" | "subscription"
+    stream_config: dict | None = None  # StreamSession kwargs (subscriptions)
 
     def __post_init__(self):
         self.X = np.asarray(self.X)
@@ -133,6 +147,19 @@ class JobSpec:
                 "serve jobs are single-output (y must be 1-D); submit one "
                 "job per output row"
             )
+        if self.kind not in ("search", "subscription"):
+            raise ValueError(
+                f"unknown job kind {self.kind!r} (search | subscription)"
+            )
+        if self.kind == "subscription":
+            if self.deadline_seconds is not None:
+                raise ValueError(
+                    "subscription jobs are deadline-less "
+                    "(deadline_seconds must be None)"
+                )
+            self.preemptible = False
+        elif self.stream_config is not None:
+            raise ValueError("stream_config is subscription-only")
         if self.niterations < 1:
             raise ValueError("niterations must be >= 1")
         if self.deadline_seconds is not None and self.deadline_seconds <= 0:
@@ -181,6 +208,11 @@ class Job:
         self.preempt_requested = threading.Event()
         self.cancel_requested = threading.Event()
         self.done_event = threading.Event()
+        # subscription plumbing: rows pushed before the session exists are
+        # staged here (guarded by the server lock) and flushed on start;
+        # ``session`` is the live StreamSession once the job is admitted
+        self.pending_rows: list = []
+        self.session = None
 
     @property
     def terminal(self) -> bool:
@@ -191,6 +223,7 @@ class Job:
             "id": self.id,
             "tenant": self.spec.tenant,
             "label": self.spec.label,
+            "kind": self.spec.kind,
             "state": self.state,
             "priority": self.spec.priority,
             "iterations_done": self.iterations_done,
@@ -299,6 +332,10 @@ class JobQueue:
                 if len(out) >= limit:
                     break
                 if job.cancel_requested.is_set():
+                    continue
+                if job.spec.kind != "search":
+                    # subscriptions own a long-lived lane of their own; they
+                    # never ride a finite fleet batch
                     continue
                 if job.bucket != lead.bucket:
                     continue

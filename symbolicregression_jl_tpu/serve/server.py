@@ -34,7 +34,13 @@ the server's whole job is to keep that cache hot:
   stop conditions, and terminal state; cancel/preempt evicts a single lane
   (the lane freezes under the fleet mask, survivors drain unchanged).
   Deadline-bearing jobs and preemption resumes bypass coalescing and run
-  solo.
+  solo;
+- **subscriptions** (``kind="subscription"``): deadline-less streaming
+  jobs backed by ``stream.StreamSession`` — the worker drives a long-lived
+  lane whose dataset updates live (``push_rows``/``replace_rows``, zero
+  recompiles within the row bucket) and whose frontier frames flow through
+  the same frame channel until the client ``cancel()``s (terminal DONE,
+  final result attached).
 
 The server is in-process by design (the engine is a Python library; remote
 transport is a thin shell over ``submit``/``frames``/``result`` and out of
@@ -147,6 +153,8 @@ class SearchServer:
             running = list(self._running.values())
         for job in running:
             job.cancel_requested.set()
+            if job.session is not None:
+                job.session.request_stop()
         if cancel_queued:
             for job in self._queue.drain():
                 self._finalize(job, q.CANCELLED, release=False)
@@ -232,9 +240,52 @@ class SearchServer:
 
     def cancel(self, job_id: str) -> None:
         """Request cancellation: queued jobs finalize on the next sweep,
-        running jobs stop at the next iteration boundary."""
-        self.job(job_id).cancel_requested.set()
+        running jobs stop at the next iteration boundary. For a
+        subscription this is the NORMAL way to end the stream — the job
+        finalizes DONE with its final SearchResult attached."""
+        job = self.job(job_id)
+        job.cancel_requested.set()
+        session = job.session
+        if session is not None:
+            session.request_stop()
         self._queue.wake_all()
+
+    def push_rows(self, job_id: str, X, y, weights=None) -> None:
+        """Append rows to a subscription's live dataset (applied at the
+        next iteration boundary; zero recompiles while the row count stays
+        within the session's row bucket). Rows pushed before the job is
+        admitted are staged and flushed when the session starts."""
+        self._stage_rows(job_id, "push", X, y, weights)
+
+    def replace_rows(self, job_id: str, X, y, weights=None) -> None:
+        """Replace a subscription's whole dataset (same feature count) at
+        the next iteration boundary."""
+        self._stage_rows(job_id, "replace", X, y, weights)
+
+    def _stage_rows(self, job_id: str, kind: str, X, y, weights) -> None:
+        import numpy as np
+
+        job = self.job(job_id)
+        if job.spec.kind != "subscription":
+            raise ValueError(f"{job_id} is not a subscription job")
+        with self._lock:
+            if job.terminal:
+                raise RuntimeError(f"{job_id} is terminal ({job.state})")
+            session = job.session
+            if session is None:  # queued: stage until the session exists
+                job.pending_rows.append(
+                    (
+                        kind,
+                        np.asarray(X),
+                        np.asarray(y),
+                        None if weights is None else np.asarray(weights),
+                    )
+                )
+                return
+        if kind == "push":
+            session.push_rows(X, y, weights)
+        else:
+            session.replace_rows(X, y, weights)
 
     def stats(self) -> dict:
         """Server + cache health: job states, warm buckets, and the unified
@@ -299,11 +350,14 @@ class SearchServer:
                 self._finalize(job, q.CANCELLED, release=False)
                 return
             try:
-                mates = self._gather_fleet(job)
-                if mates:
-                    self._run_fleet([job] + mates)
+                if job.spec.kind == "subscription":
+                    self._run_subscription(job)
                 else:
-                    self._run_job(job)
+                    mates = self._gather_fleet(job)
+                    if mates:
+                        self._run_fleet([job] + mates)
+                    else:
+                        self._run_job(job)
             except BaseException as e:  # a worker must never die silently
                 job.error = f"{type(e).__name__}: {e}"
                 self._queue.release(job)
@@ -445,6 +499,86 @@ class SearchServer:
             return
         self._finalize(job, q.DONE, release=False)
 
+    # -- subscriptions ---------------------------------------------------------
+    def _run_subscription(self, job: Job) -> None:
+        """Run a ``kind="subscription"`` job: a StreamSession driven inline
+        on this worker thread (the session IS the job's lane; it occupies
+        the worker slot until the client cancels or the engine stops on its
+        own budget). Frames flow through the job's normal frame channel;
+        pre-admission ``push_rows`` staging flushes into the live session
+        the moment it exists."""
+        from ..stream.session import StreamSession
+
+        spec = job.spec
+        with self._lock:
+            self._running[job.id] = job
+        job.started_at = job.started_at or time.time()
+        job.iteration_base = job.iterations_done
+
+        def _on_frame(frame: bytes) -> None:
+            with self._frame_cond:
+                job.frames.append(frame)
+                if job.ttff is None:
+                    job.ttff = time.time() - job.submitted_at
+                self._frame_cond.notify_all()
+
+        user_cb = spec.options.iteration_callback
+
+        def _cb(report):
+            job.iterations_done = job.iteration_base + report.iteration
+            stop = user_cb(report) if user_cb is not None else None
+            if job.cancel_requested.is_set() or self._stopping:
+                return True
+            return stop
+
+        cfg = dict(spec.stream_config or {})
+        cfg.setdefault("stream_every", spec.stream_every)
+        cfg.setdefault("label", job.id)
+        try:
+            session = StreamSession(
+                spec.X,
+                spec.y,
+                dataclasses.replace(spec.options, iteration_callback=_cb),
+                weights=spec.weights,
+                on_frame=_on_frame,
+                **cfg,
+            )
+        except BaseException as e:
+            self._release_running(job)
+            job.error = f"{type(e).__name__}: {e}"
+            self._finalize(job, q.FAILED, release=False)
+            return
+        with self._lock:
+            job.session = session
+            pending, job.pending_rows = job.pending_rows, []
+        for kind, X, y, w in pending:
+            if kind == "push":
+                session.push_rows(X, y, w)
+            else:
+                session.replace_rows(X, y, w)
+        try:
+            result = session.run()
+        except BaseException as e:
+            self._release_running(job)
+            job.error = f"{type(e).__name__}: {e}"
+            self._finalize(job, q.FAILED, release=False)
+            return
+
+        job.result = result
+        job.iterations_done = session.stats.iterations
+        self._release_running(job)
+        if self._stopping:
+            job.stop_reason = "cancelled"
+            self._finalize(job, q.CANCELLED, release=False)
+        elif job.cancel_requested.is_set():
+            # client cancel is the normal end of a subscription: terminal
+            # DONE, final result attached
+            job.stop_reason = "cancelled"
+            self._finalize(job, q.DONE, release=False)
+        else:
+            job.stop_reason = getattr(result, "stop_reason", None)
+            self._finalize(job, q.DONE, release=False)
+
     # -- fleet coalescing ------------------------------------------------------
     def _gather_fleet(self, lead: Job) -> list[Job]:
         """Coalescing admission: given a just-acquired lead job, gather up to
@@ -506,9 +640,15 @@ class SearchServer:
         OWN hall of fame (what frames/frontier/stop bookkeeping touch) —
         the decoded populations and dataset arrays are shared read-only
         across riders (a full deepcopy costs ~10ms/rider and nothing in
-        the serve path mutates them)."""
+        the serve path mutates them). ``engine_profile`` IS mutable —
+        fleet_search attaches the same summary dict (with its live
+        "counters" block) to every lane result — so it gets its own deep
+        copy per rider (aliasing pinned by tests/test_fleet.py)."""
         clone = copy.copy(result)
         clone.hall_of_fame = copy.deepcopy(result.hall_of_fame)
+        profile = getattr(result, "engine_profile", None)
+        if profile is not None:
+            clone.engine_profile = copy.deepcopy(profile)
         return clone
 
     def _fan_out(self, leader: Job, followers: list[Job], fingerprint) -> None:

@@ -1,0 +1,27 @@
+"""Streaming/online SR runtime (round 14).
+
+- :class:`StreamSession` — a long-lived fleet lane with live row swaps
+  (zero recompiles within the row bucket), drift-aware frontier upkeep,
+  and format-2 frontier frame streaming;
+- :class:`DriftDetector`/:class:`DriftConfig` — loss-on-new-rows vs
+  frontier-EMA drift detection;
+- :class:`MultitargetSearch` — multi-target SR as a fleet-of-lanes over
+  shared X.
+
+The serve layer exposes sessions as deadline-less ``kind="subscription"``
+jobs (``SearchServer.push_rows`` / ``cancel``).
+"""
+
+from .drift import DriftConfig, DriftDetector
+from .multitarget import MultitargetSearch, multitarget_search
+from .session import StreamSession, StreamStats, next_row_bucket
+
+__all__ = [
+    "DriftConfig",
+    "DriftDetector",
+    "MultitargetSearch",
+    "multitarget_search",
+    "StreamSession",
+    "StreamStats",
+    "next_row_bucket",
+]

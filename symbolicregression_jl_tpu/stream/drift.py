@@ -1,0 +1,104 @@
+"""Drift detection for streaming SR sessions — pure host arithmetic.
+
+The signal is the ratio between the CURRENT best expression's loss on the
+incoming rows and an exponential moving average of the frontier's best loss
+on the data it was evolved against. While the generating process is
+stationary, the best member generalizes and the probe ratio hovers near 1;
+when the process shifts, the frontier is suddenly wrong on the new rows and
+the ratio jumps. The detector deliberately compares LOSSES (not residual
+distributions): it reuses the session's existing scoring programs, so a
+probe costs one warm kernel call and no new compiles.
+
+On drift the session responds with (both optional, on by default):
+
+- **frontier re-scoring** — every hall-of-fame member's loss is recomputed
+  against the post-swap buffer, so the streamed frontier frames report
+  honest losses and stale members stop blocking their complexity slots;
+- **parsimony-frequency reset** — the per-lane complexity histogram
+  (``EvoState.freq``) returns to the ``init_state`` uniform, forgetting the
+  size distribution learned on the old regime.
+
+Everything here is numpy/stdlib and unit-testable without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+__all__ = ["DriftConfig", "DriftDetector"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Knobs for :class:`DriftDetector`.
+
+    ``ratio``: probe loss must exceed ``ratio * ema`` to count as drift.
+    ``ema_decay``: frontier-loss EMA smoothing (per observed iteration).
+    ``min_obs``: EMA observations required before probes can trigger —
+    the first iterations of a session have a noisy, falling best loss and
+    every push would read as drift.
+    ``rescore``: re-score the hall of fame against the new buffer on drift.
+    ``reset_freq``: reset the lane's parsimony-frequency histogram on drift.
+    """
+
+    ratio: float = 2.0
+    ema_decay: float = 0.9
+    min_obs: int = 3
+    eps: float = 1e-12
+    rescore: bool = True
+    reset_freq: bool = True
+
+    def __post_init__(self):
+        if not self.ratio > 0:
+            raise ValueError("drift ratio must be > 0")
+        if not 0.0 <= self.ema_decay < 1.0:
+            raise ValueError("ema_decay must be in [0, 1)")
+        if self.min_obs < 1:
+            raise ValueError("min_obs must be >= 1")
+
+
+class DriftDetector:
+    """EMA of the frontier's best loss + the probe-ratio drift test."""
+
+    def __init__(self, config: DriftConfig | None = None):
+        self.config = config or DriftConfig()
+        self.ema: float | None = None
+        self.observations = 0
+        self.drifts = 0
+
+    def observe(self, frontier_best_loss: float) -> None:
+        """Fold one iteration's frontier best loss into the EMA (non-finite
+        values are skipped: a frontier mid-rescore can transiently report
+        inf, which would poison the average forever)."""
+        v = float(frontier_best_loss)
+        if not math.isfinite(v):
+            return
+        d = self.config.ema_decay
+        self.ema = v if self.ema is None else d * self.ema + (1.0 - d) * v
+        self.observations += 1
+
+    def probe(self, loss_on_new_rows: float) -> bool:
+        """Drift decision for one incoming batch: is the current best
+        member's loss on the new rows out of line with the frontier EMA?
+        Non-finite probe losses ARE drift (the new rows broke the best
+        expression's domain — e.g. a log/sqrt argument went negative)."""
+        if self.ema is None or self.observations < self.config.min_obs:
+            return False
+        v = float(loss_on_new_rows)
+        if not math.isfinite(v):
+            self.drifts += 1
+            return True
+        if v > self.config.ratio * max(self.ema, self.config.eps):
+            self.drifts += 1
+            return True
+        return False
+
+    def rebase(self, frontier_best_loss: float) -> None:
+        """Reset the EMA to the post-rescore best loss, so the iterations
+        right after an acknowledged drift don't re-trigger on the same
+        regime change."""
+        v = float(frontier_best_loss)
+        self.ema = v if math.isfinite(v) else None
+        if self.ema is None:
+            self.observations = 0

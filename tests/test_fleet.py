@@ -401,6 +401,33 @@ def test_program_cache_fleet_rollup():
     }
 
 
+def test_clone_result_does_not_alias_engine_profile():
+    """fleet_search attaches ONE engine_profile summary dict (with its live
+    mutable "counters" block) to every lane result; a dedup rider's clone
+    must deep-copy it — otherwise one tenant mutating its profile (or a
+    later fleet run updating shared counters) would corrupt every rider's
+    report. Regression: copy.copy alone aliased the dict."""
+    from symbolicregression_jl_tpu.serve import SearchServer
+
+    class _Res:
+        pass
+
+    res = _Res()
+    res.hall_of_fame = None
+    res.engine_profile = {"counters": {"fused_iter": 3}, "mode": "fleet"}
+    srv = SearchServer.__new__(SearchServer)  # _clone_result touches no state
+    clone = srv._clone_result(res)
+    assert clone.engine_profile == res.engine_profile
+    assert clone.engine_profile is not res.engine_profile
+    assert clone.engine_profile["counters"] is not res.engine_profile["counters"]
+    clone.engine_profile["counters"]["fused_iter"] = 99
+    assert res.engine_profile["counters"]["fused_iter"] == 3
+    # results without a profile clone cleanly too
+    bare = _Res()
+    bare.hall_of_fame = None
+    assert not hasattr(srv._clone_result(bare), "engine_profile")
+
+
 # -- serve: end-to-end coalescing --------------------------------------------
 
 

@@ -74,6 +74,82 @@ def test_logcosh_and_reference_aliases():
     )
 
 
+def test_logistic_loss_values_and_stability():
+    """Stable BCE-on-logits with targets in {0,1}: exact at moderate
+    logits, finite (and asymptotically linear) at logits that overflow the
+    naive sigmoid form."""
+    from symbolicregression_jl_tpu.ops.losses import LogisticLoss
+
+    p = jnp.asarray([0.0, 2.0, -2.0], jnp.float32)
+    t = jnp.asarray([1.0, 1.0, 0.0], jnp.float32)
+    got = np.asarray(LogisticLoss(p, t))
+    want = np.log1p(np.exp(-np.asarray([0.0, 2.0, 2.0])))  # -log sigmoid(|p|)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # correct label at huge magnitude -> ~0; wrong label -> ~|p|, never inf
+    big = np.asarray(
+        LogisticLoss(jnp.asarray([500.0, -500.0]), jnp.asarray([1.0, 1.0]))
+    )
+    # f32 underflows to exactly 0; under x64 (left on by other suite
+    # members) it's exp(-500) ~ 7e-218 — either way vanishing, never nan
+    assert 0.0 <= float(big[0]) < 1e-100
+    assert np.isfinite(big[1]) and big[1] == pytest.approx(500.0)
+
+
+def test_make_loss_memoization_and_zoo():
+    """Equal zoo specs must return the IDENTICAL callable (callable identity
+    keys the score-fn memoization and the Pallas kernel UID caches — a fresh
+    closure per call would recompile every engine program), with aliases and
+    omitted defaults collapsing onto one closure."""
+    import symbolicregression_jl_tpu as sr
+
+    assert sr.make_loss("huber", 1.0) is sr.make_loss("huber", 1.0)
+    assert sr.make_loss("quantile") is sr.make_loss("quantile", 0.5)
+    assert sr.make_loss("pinball", 0.9) is sr.make_loss("quantile", 0.9)
+    assert sr.make_loss("Logistic") is sr.make_loss("logistic")
+    assert sr.make_loss("quantile", 0.1) is not sr.make_loss("quantile", 0.9)
+    assert sr.make_loss("l2") is sr.L2DistLoss
+    with pytest.raises(KeyError):
+        sr.make_loss("nope")
+    with pytest.raises(TypeError):
+        sr.make_loss("l2", 3.0)  # l2 takes no parameters
+    zoo = sr.loss_zoo()
+    assert {"l2", "l1", "huber", "quantile", "logistic"} <= set(zoo)
+    for meta in zoo.values():
+        assert meta["pallas"] and meta["pallas_grad"]
+    assert zoo["quantile"]["params"] == {"tau": 0.5}
+    assert zoo["logistic"]["task"] == "binary classification"
+    # quantile asymmetry: tau=0.9 charges under-prediction 9x over-prediction
+    q = sr.make_loss("quantile", 0.9)
+    under = float(np.asarray(q(jnp.asarray(0.0), jnp.asarray(1.0))))
+    over = float(np.asarray(q(jnp.asarray(1.0), jnp.asarray(0.0))))
+    assert under == pytest.approx(0.9) and over == pytest.approx(0.1)
+
+
+def test_logistic_sr_recovers_decision_boundary():
+    """End-to-end classification SR: labels from sign(x0 + x1), searched
+    with the logistic head — the evolved logit must score far below the
+    predict-nothing baseline (log 2) AND separate the classes by sign."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.ops import eval_trees, flatten_trees
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 128)).astype(np.float32)
+    y = (X[0] + X[1] > 0).astype(np.float32)
+    opts = sr.Options(
+        binary_operators=["+", "-", "*"], unary_operators=[],
+        elementwise_loss=sr.make_loss("logistic"), populations=4,
+        population_size=16, ncycles_per_iteration=40, maxsize=8,
+        save_to_file=False, seed=0,
+    )
+    res = sr.equation_search(X, y, options=opts, niterations=6, verbosity=0)
+    best = min(res.pareto_frontier, key=lambda m: m.loss)
+    assert best.loss < 0.45, str(best.tree)  # baseline (always-0 logit): 0.693
+    flat = flatten_trees([best.tree], opts.max_nodes)
+    logits = np.asarray(eval_trees(flat, jnp.asarray(X), opts.operators))[0]
+    acc = float(np.mean((logits > 0) == (y > 0.5)))
+    assert acc >= 0.9, (acc, str(best.tree))
+
+
 def test_lp_dist_loss_factory():
     """LPDistLoss(p) — the generic p-norm loss the reference re-exports
     (/root/reference/src/SymbolicRegression.jl:116): importable from the

@@ -159,6 +159,98 @@ def test_constant_gradient_parity():
         assert np.abs(g_s).max() > 0
 
 
+# -- loss zoo: every head traces through the fused kernels --------------------
+#
+# The kernels take the elementwise loss as a generic traced callable, so zoo
+# coverage is structural — but these pin it numerically, forward AND grad,
+# against the scan interpreter (same tolerances as the L2 tests above).
+
+_ZOO_CASES = [
+    ("logistic", ()),
+    ("quantile", (0.25,)),
+    ("huber", (1.0,)),
+]
+
+
+def _zoo_target(name, y):
+    # logistic is a classification head: targets live in {0, 1}
+    return (y > 0).astype(np.float32) if name == "logistic" else y
+
+
+@pytest.mark.parametrize("name,params", _ZOO_CASES, ids=[c[0] for c in _ZOO_CASES])
+def test_zoo_forward_loss_parity(name, params):
+    from symbolicregression_jl_tpu import make_loss
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        make_pallas_loss_fn,
+        pallas_supported,
+    )
+    from symbolicregression_jl_tpu.ops.scoring import batched_loss_jit
+
+    loss = make_loss(name, *params)
+    assert pallas_supported(OPTS.operators, 5, loss)
+    X, y, w = _data()
+    y = _zoo_target(name, y)
+    rng = np.random.default_rng(4)
+    flat = flatten_trees(Population.random_trees(32, OPTS, 5, rng), OPTS.max_nodes)
+    for weights in (None, w):
+        got = np.asarray(
+            make_pallas_loss_fn(X, y, weights, OPTS.operators, loss)(flat)
+        )
+        want = np.asarray(
+            batched_loss_jit(
+                flat,
+                jnp.asarray(X),
+                jnp.asarray(y),
+                None if weights is None else jnp.asarray(weights),
+                OPTS.operators,
+                loss,
+            )
+        )
+        assert (np.isinf(got) == np.isinf(want)).all()
+        fin = np.isfinite(got)
+        assert fin.any()
+        np.testing.assert_allclose(got[fin], want[fin], rtol=1e-6)
+
+
+@pytest.mark.parametrize("name,params", _ZOO_CASES, ids=[c[0] for c in _ZOO_CASES])
+def test_zoo_constant_gradient_parity(name, params):
+    """Const-opt gradients through the custom_vjp kernel for each zoo head —
+    the path a logistic/quantile SR search drives every const-opt step."""
+    from symbolicregression_jl_tpu import make_loss
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        make_pallas_diff_loss_fn,
+        pack_flat_fused,
+        pallas_grad_supported,
+    )
+
+    loss = make_loss(name, *params)
+    assert pallas_grad_supported(OPTS.operators, 5, loss)
+    X, y, w = _data()
+    y = _zoo_target(name, y)
+    flat = flatten_trees(_grad_trees(), OPTS.max_nodes)
+    N = flat.kind.shape[1]
+    ints = jnp.asarray(pack_flat_fused(flat, OPTS.operators)[0])
+    v0 = jnp.asarray(flat.val, jnp.float32)
+
+    def scan_losses(vals):
+        fl = flat._replace(val=vals)
+        preds = eval_trees(fl, jnp.asarray(X), OPTS.operators)
+        elem = loss(preds, jnp.asarray(y)[None, :])
+        return weighted_mean_loss(elem, jnp.asarray(w)[None, :])
+
+    dfn = make_pallas_diff_loss_fn(X, y, w, OPTS.operators, loss)
+    loss_p, pull = jax.vjp(lambda v: dfn(ints, v, N), v0)
+    (g_p,) = pull(jnp.ones_like(loss_p))
+    loss_s, pull_s = jax.vjp(scan_losses, v0)
+    (g_s,) = pull_s(jnp.ones_like(loss_s))
+    loss_p, loss_s = np.asarray(loss_p), np.asarray(loss_s)
+    g_p, g_s = np.asarray(g_p), np.asarray(g_s)
+    assert np.isfinite(loss_p).all()
+    np.testing.assert_allclose(loss_p, loss_s, rtol=1e-6)
+    np.testing.assert_allclose(g_p, g_s, rtol=2e-6, atol=2e-6 * np.abs(g_s).max())
+    assert np.abs(g_s).max() > 0
+
+
 def test_engine_interpret_matches_scan_engine(monkeypatch):
     """End-to-end: the device engine with Pallas scoring + Pallas-grad
     const-opt (emulated) reproduces the scan engine's frontier — same

@@ -119,6 +119,30 @@ def test_take_expired_and_drain():
     assert len(q) == 0
 
 
+def test_deadline_none_never_expires():
+    """``deadline_seconds=None`` means NEVER expires: ``deadline_at`` stays
+    None, the queue-side expiry sweep skips the job at any ``now``, and the
+    mid-run deadline guard in the server compares against None-safe state
+    only. Regression for the r14 subscription path (deadline-less jobs are
+    its foundation) — a naive ``now >= deadline_at`` would TypeError or,
+    worse, expire everything."""
+    q = JobQueue()
+    forever = _mkjob(1)  # default: deadline_seconds=None
+    assert forever.spec.deadline_seconds is None
+    assert forever.deadline_at is None
+    q.submit(forever)
+    # queue-side: no wall clock ever expires it
+    assert q.take_expired(now=time.time() + 1e9) == []
+    assert len(q) == 1
+    assert q.drain() == [forever]
+    # mid-run: the server's lane options keep the tenant's own timeout
+    # untouched (no deadline budget is folded in)
+    srv = SearchServer.__new__(SearchServer)
+    opts = srv._lane_options(forever, fingerprint=(), now=time.time())
+    assert opts.timeout_in_seconds is None
+    assert opts.max_evals is None
+
+
 # -- daemon tests --------------------------------------------------------------
 
 
