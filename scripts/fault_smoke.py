@@ -18,10 +18,18 @@ Three end-to-end cycles through the fault-tolerant runtime, minutes not hours:
    ``SR_ELASTIC_JOIN=1``, and must rejoin at a later membership epoch,
    adopt the leader's checkpoint shard, and finish — with the survivor's
    final frontier matching a no-fault elastic run within tolerance.
+4. **Serve durability**: a journaled ``SearchServer`` subprocess loses a
+   worker thread to an injected ``worker_crash`` (supervisor restarts it),
+   then is SIGKILLed mid-batch with two jobs done and one mid-run with
+   spool checkpoints. A recovery server on the same journal dir must
+   surface every job (zero lost, zero duplicated), resume the running job
+   from its checkpoint, and land a frontier bit-identical to an
+   uninterrupted run. Also exercises in-process: transient ``job_exception``
+   retried to DONE and a persistent one escalated to QUARANTINED.
 
 Exits nonzero on the first violated invariant. Usage: python
-scripts/fault_smoke.py [checkpoint|exchange|elastic] (CI passes no args =
-all; JAX_PLATFORMS=cpu is forced).
+scripts/fault_smoke.py [checkpoint|exchange|elastic|serve] (CI passes no
+args = all; JAX_PLATFORMS=cpu is forced).
 """
 
 from __future__ import annotations
@@ -335,16 +343,178 @@ def smoke_elastic_rejoin() -> None:
     )
 
 
+_SERVE_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.serve import JobSpec, SearchServer
+from symbolicregression_jl_tpu.utils.checkpoint import latest_checkpoint
+
+jdir = sys.argv[1]
+rng = np.random.default_rng(0)
+X = rng.normal(size=(2, 64)).astype(np.float32)
+y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+opts = Options(
+    binary_operators=["+", "-", "*"], unary_operators=["cos"],
+    populations=2, population_size=12, ncycles_per_iteration=8,
+    maxsize=12, seed=0, scheduler="lockstep", save_to_file=False,
+)
+# SR_FAULT_SPEC=worker_crash@0 (set by the parent) kills the first worker
+# thread at its first acquire; the supervisor must restart it for ANY job
+# to finish
+srv = SearchServer(max_concurrency=1, journal_dir=jdir,
+                   ckpt_every_s=0.05).start()
+for _ in range(2):
+    srv.submit(JobSpec(X, y, options=opts, niterations=2))
+long_id = srv.submit(JobSpec(X, y, options=opts, niterations=40))
+base = os.path.join(srv.spool_dir, long_id + ".engine")
+deadline = time.time() + 300
+while time.time() < deadline:
+    if (srv.stats()["jobs"].get("done", 0) >= 2
+            and latest_checkpoint(base) is not None):
+        print("MID " + long_id, flush=True)
+        break
+    time.sleep(0.05)
+time.sleep(600)  # hold mid-run until the parent SIGKILLs this process
+"""
+
+
+def smoke_serve_durability() -> None:
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.serve import (
+        DONE,
+        QUARANTINED,
+        JobSpec,
+        SearchServer,
+    )
+    from symbolicregression_jl_tpu.utils import faults
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+
+    def opts():
+        return Options(
+            binary_operators=["+", "-", "*"], unary_operators=["cos"],
+            populations=2, population_size=12, ncycles_per_iteration=8,
+            maxsize=12, seed=0, scheduler="lockstep", save_to_file=False,
+        )
+
+    reference = equation_search(
+        X, y, options=opts(), niterations=40, verbosity=0
+    )
+
+    with tempfile.TemporaryDirectory() as d:
+        # --- kill drill: worker_crash, then SIGKILL the whole server --------
+        script = os.path.join(d, "serve_child.py")
+        with open(script, "w") as f:
+            f.write(_SERVE_CHILD.format(repo=REPO))
+        jdir = os.path.join(d, "journal")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SR_FAULT_SPEC"] = "worker_crash@0"
+        proc = subprocess.Popen(
+            [sys.executable, script, jdir],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO,
+        )
+        long_id, lines = None, []
+        try:
+            for line in proc.stdout:
+                lines.append(line)
+                if line.startswith("MID "):
+                    long_id = line.split()[1]
+                    break
+        finally:
+            proc.kill()
+            proc.wait(timeout=60)
+        if long_id is None:
+            raise SystemExit(
+                "FAIL: serve child never reached mid-run:\n" + "".join(lines)
+            )
+
+        with SearchServer(max_concurrency=1, journal_dir=jdir) as srv:
+            rec = srv.stats()["journal"]["recovered"]
+            if rec["terminal"] != 2 or rec["running"] != 1 or rec["resumed"] < 1:
+                raise SystemExit(
+                    f"FAIL: recovery saw {rec}, expected 2 terminal + 1 "
+                    "running job resumed from its spool checkpoint"
+                )
+            with srv._lock:
+                ids = sorted(srv._jobs)
+            if len(ids) != 3 or len(set(ids)) != 3:
+                raise SystemExit(f"FAIL: jobs lost or duplicated: {ids}")
+            for jid in ids:
+                job = srv.wait(jid, timeout=600)
+                if job.state != DONE:
+                    raise SystemExit(
+                        f"FAIL: recovered job not DONE: {job.summary()}"
+                    )
+            long_job = srv.job(long_id)
+            if not long_job.resumed_from_iteration:
+                raise SystemExit(
+                    "FAIL: killed running job restarted from scratch instead "
+                    f"of resuming: {long_job.summary()}"
+                )
+            o = opts()
+            if _frontier(long_job.result, o) != _frontier(reference, o):
+                raise SystemExit(
+                    "FAIL: recovered job's frontier differs from the "
+                    f"uninterrupted run\n  full:      {_frontier(reference, o)}"
+                    f"\n  recovered: {_frontier(long_job.result, o)}"
+                )
+        resumed_at = long_job.resumed_from_iteration
+
+        # --- retry/quarantine escalation (in-process) -----------------------
+        faults.install("job_exception@0")
+        with SearchServer(
+            max_concurrency=1, spool_dir=os.path.join(d, "sp1"),
+            retry_backoff_s=0.02,
+        ) as srv:
+            job = srv.wait(srv.submit(JobSpec(X, y, options=opts(),
+                                              niterations=2)), timeout=600)
+            if job.state != DONE or job.attempts != 2:
+                raise SystemExit(
+                    f"FAIL: transient job_exception not retried to DONE: "
+                    f"{job.summary()}"
+                )
+        faults.install("job_exception@0;job_exception@1")
+        with SearchServer(
+            max_concurrency=1, spool_dir=os.path.join(d, "sp2"),
+            job_retries=1, retry_backoff_s=0.02,
+        ) as srv:
+            job = srv.wait(srv.submit(JobSpec(X, y, options=opts(),
+                                              niterations=2)), timeout=600)
+            if job.state != QUARANTINED or not job.traceback:
+                raise SystemExit(
+                    "FAIL: persistent job_exception not quarantined with a "
+                    f"traceback: {job.summary()}"
+                )
+        faults.install(None)
+    print(
+        "OK serve durability: SIGKILL'd server recovered 3/3 jobs "
+        f"(running job resumed at iteration {resumed_at}, frontier "
+        "bit-exact); retries escalate to quarantine"
+    )
+
+
 if __name__ == "__main__":
     which = set(sys.argv[1:]) or {"all"}
-    unknown = which - {"all", "checkpoint", "exchange", "elastic"}
+    unknown = which - {"all", "checkpoint", "exchange", "elastic", "serve"}
     if unknown:
         sys.exit(f"unknown cycle(s): {sorted(unknown)} "
-                 "(choose from: checkpoint exchange elastic)")
+                 "(choose from: checkpoint exchange elastic serve)")
     if which & {"all", "checkpoint"}:
         smoke_checkpoint_resume()
     if which & {"all", "exchange"}:
         smoke_degraded_exchange()
     if which & {"all", "elastic"}:
         smoke_elastic_rejoin()
+    if which & {"all", "serve"}:
+        smoke_serve_durability()
     print("FAULT_SMOKE=pass")

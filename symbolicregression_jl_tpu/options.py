@@ -451,6 +451,37 @@ class Options:
         w = p * (1 - p) ** np.arange(n)
         self._tournament_weights = w / w.sum()
 
+    # pickling --------------------------------------------------------------
+    # The derived OperatorSet wraps jax callables (jnp.cos et al.) that are
+    # re-exported under names pickle refuses to resolve, so Options is only
+    # picklable if the compiled/derived state is dropped and rebuilt on load.
+    # This is what lets the serve-layer job journal persist a JobSpec: only
+    # the declared hyperparameters travel, and __post_init__ re-derives the
+    # rest on the recovering process. Custom operator/loss CALLABLES still
+    # pickle by reference like any function — specs built from lambdas
+    # remain undurable, which the journal degrades to gracefully.
+
+    _DERIVED = (
+        "operators",
+        "loss",
+        "max_nodes",
+        "_op_constraints",
+        "_nested_constraints",
+        "_complexity_mapping",
+        "_needs_node_cap",
+        "_tournament_weights",
+    )
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        for name in self._DERIVED:
+            state.pop(name, None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.__post_init__()
+
     # hooks used across the stack ------------------------------------------
 
     @property

@@ -12,8 +12,12 @@ Three layers:
 - ``server`` — ``SearchServer``: worker threads multiplexing jobs over the
   mesh, streaming frontier frames (format-2 bytes), enforcing deadlines,
   and preempting/resuming via spool checkpoints.
+- ``journal`` — ``JobJournal``: the opt-in write-ahead log behind
+  ``SearchServer(journal_dir=...)`` crash recovery, retries, and the
+  QUARANTINED poison-job state.
 """
 
+from .journal import JobJournal
 from .program_cache import (
     ProgramCache,
     enable_persistent_compilation_cache,
@@ -25,12 +29,14 @@ from .queue import (
     EXPIRED,
     FAILED,
     PREEMPTED,
+    QUARANTINED,
     QUEUED,
     RUNNING,
     TERMINAL_STATES,
     Job,
     JobQueue,
     JobSpec,
+    ServerOverloaded,
     options_digest,
     queue_age_seconds,
     shape_bucket,
@@ -44,7 +50,9 @@ __all__ = [
     "JobSpec",
     "Job",
     "JobQueue",
+    "JobJournal",
     "SearchServer",
+    "ServerOverloaded",
     "shape_bucket",
     "options_digest",
     "queue_age_seconds",
@@ -55,5 +63,6 @@ __all__ = [
     "FAILED",
     "EXPIRED",
     "CANCELLED",
+    "QUARANTINED",
     "TERMINAL_STATES",
 ]

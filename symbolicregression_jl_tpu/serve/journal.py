@@ -1,0 +1,322 @@
+"""Write-ahead job journal for the durable serve runtime.
+
+Every job lifecycle transition the :class:`~.server.SearchServer` must be
+able to reconstruct after a crash is appended here as one CRC-framed
+record: ``submit`` (with the pickled JobSpec — the payload a restarted
+server needs to resubmit the job), ``start`` (attempt count + the spool
+checkpoint base the engine snapshots into), ``progress`` (throttled
+iteration heartbeats, informational), ``requeue`` (retry/preempt with
+backoff ``not_before`` and the checkpoint to resume from), and ``terminal``
+(final state + error). Replaying the journal yields one merged record per
+job — the exact worklist crash recovery resubmits.
+
+Durability discipline (the r08 checkpoint rules, applied to a log):
+
+- **Appends are framed**: ``u32 length | u32 crc32 | pickle payload`` after
+  an 8-byte file magic. A crash mid-append leaves a *torn tail* — a frame
+  whose length/CRC/pickle cannot validate — and :meth:`replay` truncates
+  the file back to the last good frame instead of raising: a torn tail can
+  lose at most the record being written, never a committed one, and replay
+  can never invent a job from garbage bytes.
+- **Records that gate correctness are fsynced** (submit/start/requeue/
+  terminal); ``progress`` heartbeats flush without fsync — losing them
+  costs nothing (the engine checkpoint carries the authoritative
+  iteration).
+- **Rotation is atomic**: when the log outgrows ``max_bytes`` (default
+  ``SR_SERVE_JOURNAL_MAX_MB`` = 64), the merged state is compacted into
+  ``snapshot`` records written tmp-first, fsynced, and promoted with
+  ``os.replace`` — the same tmp+fsync+rename window the checkpointer uses,
+  so a crash mid-rotation keeps the previous log intact. Terminal jobs
+  survive one rotation as slim tombstones (spec dropped) so a restarted
+  server still reports them exactly once, and the oldest tombstones are
+  pruned past ``keep_terminal``.
+
+The journal is entirely optional: with no ``journal_dir`` the server never
+constructs one and every call site is a ``None`` guard — zero locks, zero
+I/O on the undurable hot path.
+
+The ``journal_torn_write`` fault site (``utils/faults.py``) deterministically
+produces a half-written frame for the torn-tail drills.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import time
+import zlib
+
+__all__ = ["JobJournal", "JOURNAL_MAGIC"]
+
+JOURNAL_MAGIC = b"SRJRNL01"
+_HDR = struct.Struct("<II")  # payload length, crc32(payload)
+_MAX_RECORD = 1 << 27  # 128 MB: a length field past this is corruption
+
+
+def _journal_max_bytes() -> int:
+    try:
+        mb = float(os.environ.get("SR_SERVE_JOURNAL_MAX_MB", "64"))
+    except ValueError:
+        mb = 64.0
+    return int(mb * (1 << 20))
+
+
+def _fresh_state(job_id: str) -> dict:
+    return {
+        "job": job_id,
+        "seq": 0,
+        "state": "queued",
+        "attempts": 0,
+        "spec": None,  # pickled JobSpec bytes, or None (undurable)
+        "kind": "search",
+        "submitted_at": 0.0,
+        "not_before": 0.0,
+        "ckpt": None,  # checkpoint base/path to resume from
+        "iterations_done": 0,
+        "error": None,
+    }
+
+
+class JobJournal:
+    """Append-only, CRC-framed, crash-truncating job journal.
+
+    Thread-safe: submit-side and worker threads append concurrently. The
+    journal also maintains the merged per-job state map as records are
+    appended/replayed, so rotation can compact from its own view and crash
+    recovery reads one dict per job instead of re-merging."""
+
+    def __init__(
+        self,
+        directory: str,
+        fsync: bool = True,
+        max_bytes: int | None = None,
+        keep_terminal: int = 1000,
+    ):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.path = os.path.join(directory, "journal.log")
+        self.fsync = bool(fsync)
+        self.max_bytes = _journal_max_bytes() if max_bytes is None else int(max_bytes)
+        self.keep_terminal = int(keep_terminal)
+        self._lock = threading.RLock()
+        self._state: dict[str, dict] = {}
+        self._fh = None
+        self._appended = 0
+        self._rotations = 0
+        self._torn_bytes = 0
+        self._undurable = 0
+
+    # -- record merge ---------------------------------------------------------
+    def _merge(self, rec: dict) -> None:
+        job_id = rec.get("job")
+        if not isinstance(job_id, str):
+            return
+        st = self._state.setdefault(job_id, _fresh_state(job_id))
+        t = rec.get("type")
+        if t in ("submit", "snapshot"):
+            for key in (
+                "seq", "state", "attempts", "spec", "kind", "submitted_at",
+                "not_before", "ckpt", "iterations_done", "error",
+            ):
+                if key in rec:
+                    st[key] = rec[key]
+        elif t == "start":
+            st["state"] = "running"
+            st["attempts"] = int(rec.get("attempts", st["attempts"]))
+            if rec.get("ckpt") is not None:
+                st["ckpt"] = rec["ckpt"]
+        elif t == "requeue":
+            st["state"] = "queued"
+            st["attempts"] = int(rec.get("attempts", st["attempts"]))
+            st["not_before"] = float(rec.get("not_before", 0.0))
+            if rec.get("ckpt") is not None:
+                st["ckpt"] = rec["ckpt"]
+            if rec.get("error") is not None:
+                st["error"] = rec["error"]
+        elif t == "progress":
+            st["iterations_done"] = int(
+                rec.get("iterations_done", st["iterations_done"])
+            )
+        elif t == "terminal":
+            st["state"] = rec.get("state", "failed")
+            st["error"] = rec.get("error")
+
+    # -- replay ---------------------------------------------------------------
+    def replay(self) -> dict[str, dict]:
+        """Read the log, truncate any torn tail, and return the merged
+        per-job state (a deep-enough copy: one fresh dict per job). Never
+        raises on a torn/corrupt tail — the first frame that fails the
+        length/CRC/pickle checks ends the replay and the file is truncated
+        back to the last committed frame."""
+        with self._lock:
+            self._close()
+            self._state = {}
+            if not os.path.exists(self.path):
+                self._reset_file()
+                self._open_append()
+                return {}
+            with open(self.path, "rb") as f:
+                data = f.read()
+            if data[: len(JOURNAL_MAGIC)] != JOURNAL_MAGIC:
+                # not our log (or torn inside the magic): start fresh
+                self._torn_bytes += len(data)
+                self._reset_file()
+                self._open_append()
+                return {}
+            good = len(JOURNAL_MAGIC)
+            off = good
+            records: list[dict] = []
+            while True:
+                if off + _HDR.size > len(data):
+                    break
+                length, crc = _HDR.unpack_from(data, off)
+                if length == 0 or length > _MAX_RECORD:
+                    break
+                end = off + _HDR.size + length
+                if end > len(data):
+                    break
+                payload = data[off + _HDR.size : end]
+                if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    break
+                try:
+                    rec = pickle.loads(payload)
+                except Exception:
+                    break
+                if not isinstance(rec, dict) or "type" not in rec:
+                    break
+                records.append(rec)
+                off = good = end
+            if good < len(data):
+                self._torn_bytes += len(data) - good
+                with open(self.path, "r+b") as f:
+                    f.truncate(good)
+            for rec in records:
+                self._merge(rec)
+            self._open_append()
+            return {k: dict(v) for k, v in self._state.items()}
+
+    # -- append ---------------------------------------------------------------
+    def append(self, type_: str, job_id: str, fsync: bool = True, **fields) -> None:
+        """Append one record. ``fsync=False`` (progress heartbeats) flushes
+        to the OS but skips the disk barrier."""
+        from ..utils import faults
+
+        rec = {"type": type_, "job": job_id, "t": time.time(), **fields}
+        payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _HDR.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with self._lock:
+            if self._fh is None:
+                self._open_append()
+            hit = faults.active().fire("journal_torn_write")
+            if hit is not None:
+                # half a frame, flushed: exactly the crash-mid-append tail
+                cut = max(1, len(frame) // 2)
+                self._fh.write(frame[:cut])
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                raise faults.FaultInjected("injected journal_torn_write")
+            self._fh.write(frame)
+            self._fh.flush()
+            if fsync and self.fsync:
+                os.fsync(self._fh.fileno())
+            self._merge(rec)
+            self._appended += 1
+            if self.max_bytes and self._fh.tell() > self.max_bytes:
+                self._rotate_locked()
+
+    def append_submit(self, job) -> bool:
+        """Journal a submit, pickling the JobSpec so a restarted server can
+        resubmit it. Specs that cannot pickle (closures in Options) are
+        journaled spec-less — the job's lifecycle is still accounted, but it
+        cannot be resurrected. Returns whether the job is durable."""
+        try:
+            spec_bytes = pickle.dumps(job.spec, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:
+            spec_bytes = None
+            with self._lock:
+                self._undurable += 1
+        self.append(
+            "submit",
+            job.id,
+            seq=job.seq,
+            submitted_at=job.submitted_at,
+            spec=spec_bytes,
+            kind=job.spec.kind,
+        )
+        return spec_bytes is not None
+
+    # -- rotation -------------------------------------------------------------
+    def rotate(self) -> None:
+        """Compact the log to one ``snapshot`` record per job (atomic
+        tmp+fsync+rename). Live jobs keep their spec bytes; terminal jobs
+        become slim tombstones (spec dropped) and only the newest
+        ``keep_terminal`` of them are retained."""
+        with self._lock:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        from .queue import TERMINAL_STATES
+
+        terminal = sorted(
+            (st for st in self._state.values() if st["state"] in TERMINAL_STATES),
+            key=lambda st: st["seq"],
+        )
+        for st in terminal[: -self.keep_terminal] if self.keep_terminal else terminal:
+            del self._state[st["job"]]
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(JOURNAL_MAGIC)
+            for st in sorted(self._state.values(), key=lambda s: s["seq"]):
+                rec = {"type": "snapshot", "t": time.time(), **st}
+                if st["state"] in TERMINAL_STATES:
+                    rec["spec"] = None  # tombstone: reported once, never rerun
+                payload = pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL)
+                f.write(
+                    _HDR.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF)
+                    + payload
+                )
+            f.flush()
+            os.fsync(f.fileno())
+        self._close()
+        os.replace(tmp, self.path)
+        self._rotations += 1
+        self._open_append()
+
+    # -- plumbing -------------------------------------------------------------
+    def _reset_file(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(JOURNAL_MAGIC)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def _open_append(self) -> None:
+        if not os.path.exists(self.path):
+            self._reset_file()
+        self._fh = open(self.path, "ab")
+
+    def _close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "path": self.path,
+                "records": len(self._state),
+                "appended": self._appended,
+                "rotations": self._rotations,
+                "torn_bytes_truncated": self._torn_bytes,
+                "undurable_specs": self._undurable,
+            }

@@ -39,8 +39,8 @@ from typing import Any
 import numpy as np
 
 __all__ = [
-    "JobSpec", "Job", "JobQueue", "shape_bucket", "options_digest",
-    "queue_age_seconds",
+    "JobSpec", "Job", "JobQueue", "ServerOverloaded", "shape_bucket",
+    "options_digest", "queue_age_seconds",
 ]
 
 
@@ -53,8 +53,18 @@ DONE = "done"
 FAILED = "failed"
 EXPIRED = "expired"  # deadline elapsed (queued or mid-run)
 CANCELLED = "cancelled"
+QUARANTINED = "quarantined"  # poison job: failed on every retry attempt
 
-TERMINAL_STATES = frozenset({DONE, FAILED, EXPIRED, CANCELLED})
+TERMINAL_STATES = frozenset(
+    {DONE, FAILED, EXPIRED, CANCELLED, QUARANTINED}
+)
+
+
+class ServerOverloaded(RuntimeError):
+    """submit() rejected: the queue is at ``SR_QUEUE_MAX_DEPTH``. The job was
+    never created — resubmit later (load shedding is the backpressure
+    contract; an unbounded queue under sustained overload only converts
+    every deadline into an expiry)."""
 
 
 def queue_age_seconds() -> float:
@@ -205,6 +215,18 @@ class Job:
         self.iteration_base = 0  # completed iterations before the current run
         self.preemptions = 0
         self.resume_path: str | None = None
+        # -- durability / self-healing state (r15) --
+        self.attempts = 0  # run attempts consumed (retry accounting)
+        self.not_before = 0.0  # backoff: not admissible before this wall time
+        self.solo_only = False  # retried fleet mate: never coalesce again
+        self.traceback: str | None = None  # bounded formatted traceback
+        self.heartbeat: float | None = None  # wall time of last iteration tick
+        self.stall_stop = threading.Event()  # watchdog's cooperative stop
+        self.quota_held = False  # tenant quota slot charged (idempotent release)
+        self.resume_absolute = False  # exact lockstep resume: callback reports
+        #                               ABSOLUTE iterations, not run-relative
+        self.resumed_from_iteration: int | None = None
+        self.journal_progress_at = 0.0  # last progress-record wall time
         self.preempt_requested = threading.Event()
         self.cancel_requested = threading.Event()
         self.done_event = threading.Event()
@@ -228,9 +250,11 @@ class Job:
             "priority": self.spec.priority,
             "iterations_done": self.iterations_done,
             "preemptions": self.preemptions,
+            "attempts": self.attempts,
             "ttff_seconds": self.ttff,
             "stop_reason": self.stop_reason,
             "error": self.error,
+            "traceback": self.traceback,
             "frames": len(self.frames),
         }
 
@@ -278,6 +302,8 @@ class JobQueue:
         for job in self._pending:
             if job.cancel_requested.is_set():
                 continue
+            if job.not_before > now:
+                continue  # retry backoff: deferred, not admissible yet
             tenant = job.spec.tenant
             if self._running_by_tenant.get(tenant, 0) >= self._quota(tenant):
                 continue
@@ -308,6 +334,7 @@ class JobQueue:
                         self._running_by_tenant.get(t, 0) + 1
                     )
                     job.state = RUNNING
+                    job.quota_held = True
                     return job
                 if deadline is None:
                     self._cond.wait()
@@ -326,6 +353,7 @@ class JobQueue:
         drain), no resume checkpoint (a preempted job warm-starts solo), and
         not cancelled. FIFO within the bucket; never blocks."""
         out: list[Job] = []
+        now = time.time()
         with self._cond:
             taken = []
             for job in sorted(self._pending, key=lambda j: j.seq):
@@ -341,6 +369,11 @@ class JobQueue:
                     continue
                 if job.deadline_at is not None or job.resume_path is not None:
                     continue
+                if job.solo_only or job.not_before > now:
+                    # a job retried after a fleet failure is isolated: it
+                    # never re-enters a coalesced batch, and backoff-deferred
+                    # jobs are not admissible yet
+                    continue
                 tenant = job.spec.tenant
                 if self._running_by_tenant.get(tenant, 0) >= self._quota(tenant):
                     continue
@@ -349,6 +382,7 @@ class JobQueue:
                     self._running_by_tenant.get(tenant, 0) + 1
                 )
                 job.state = RUNNING
+                job.quota_held = True
                 out.append(job)
             for job in taken:
                 self._pending.remove(job)
@@ -356,8 +390,14 @@ class JobQueue:
 
     def release(self, job: Job) -> None:
         """Return the tenant's quota slot when a job leaves RUNNING (to a
-        terminal state or back to the queue via preemption)."""
+        terminal state or back to the queue via preemption). Idempotent:
+        keyed on ``job.quota_held`` so a failure path that releases in its
+        handler AND in the worker loop's catch-all cannot double-credit the
+        tenant."""
         with self._cond:
+            if not job.quota_held:
+                return
+            job.quota_held = False
             t = job.spec.tenant
             n = self._running_by_tenant.get(t, 0) - 1
             if n > 0:

@@ -35,6 +35,18 @@ the server's whole job is to keep that cache hot:
   (the lane freezes under the fleet mask, survivors drain unchanged).
   Deadline-bearing jobs and preemption resumes bypass coalescing and run
   solo;
+- **durability + self-healing** (opt-in, ``journal_dir=`` /
+  ``SR_SERVE_JOURNAL_DIR``): every job transition is appended to a
+  write-ahead ``JobJournal`` (journal.py) and running lanes snapshot into
+  the spool every ``SR_SERVE_CKPT_EVERY_S`` via the engine's own
+  checkpointer, so a crashed/killed server restarted on the same
+  ``journal_dir`` resubmits its queue and RESUMES its running jobs instead
+  of losing them. Failed runs retry with exponential backoff up to
+  ``SR_JOB_RETRIES`` then terminate QUARANTINED; a supervisor thread
+  restarts dead workers and a ``SR_JOB_STALL_S`` watchdog stops+retries
+  runs whose iteration heartbeat froze; ``SR_QUEUE_MAX_DEPTH`` sheds
+  submits with ``ServerOverloaded`` under sustained overload. All of it is
+  inert (no locks, no I/O) when the journal is off;
 - **subscriptions** (``kind="subscription"``): deadline-less streaming
   jobs backed by ``stream.StreamSession`` — the worker drives a long-lived
   lane whose dataset updates live (``push_rows``/``replace_rows``, zero
@@ -54,16 +66,47 @@ import copy
 import dataclasses
 import hashlib
 import os
+import pickle
 import shutil
 import tempfile
 import threading
 import time
+import traceback as _tbmod
 
 from . import queue as q
 from .program_cache import enable_persistent_compilation_cache, global_program_cache
-from .queue import Job, JobQueue, JobSpec
+from .queue import Job, JobQueue, JobSpec, ServerOverloaded
 
-__all__ = ["SearchServer", "JobSpec"]
+__all__ = ["SearchServer", "JobSpec", "ServerOverloaded"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _format_error(e: BaseException) -> str:
+    return f"{type(e).__name__}: {e}"
+
+
+def _format_traceback(
+    e: BaseException, limit: int = 25, max_chars: int = 8192
+) -> str:
+    """Bounded formatted traceback: deep enough to debug a failed job,
+    capped so a quarantined job cannot bloat summaries or the journal."""
+    tb = "".join(
+        _tbmod.format_exception(type(e), e, e.__traceback__, limit=limit)
+    )
+    return tb[-max_chars:]
 
 
 class SearchServer:
@@ -92,11 +135,46 @@ class SearchServer:
         fleet: bool = False,
         fleet_max: int | None = None,
         fleet_window_s: float | None = None,
+        journal_dir: str | None = None,
+        ckpt_every_s: float | None = None,
+        job_retries: int | None = None,
+        retry_backoff_s: float | None = None,
+        stall_seconds: float | None = None,
+        queue_max_depth: int | None = None,
     ):
         if max_concurrency < 1:
             raise ValueError("max_concurrency must be >= 1")
         self.max_concurrency = int(max_concurrency)
         self.poll_seconds = float(poll_seconds)
+        # -- durability / self-healing knobs (r15) --
+        self.journal_dir = journal_dir or os.environ.get(
+            "SR_SERVE_JOURNAL_DIR"
+        ) or None
+        self.ckpt_every_s = (
+            _env_float("SR_SERVE_CKPT_EVERY_S", 30.0)
+            if ckpt_every_s is None
+            else float(ckpt_every_s)
+        )
+        self.job_retries = (
+            _env_int("SR_JOB_RETRIES", 2)
+            if job_retries is None
+            else int(job_retries)
+        )
+        self.retry_backoff_s = (
+            _env_float("SR_JOB_RETRY_BACKOFF_S", 0.25)
+            if retry_backoff_s is None
+            else float(retry_backoff_s)
+        )
+        self.stall_s = (
+            _env_float("SR_JOB_STALL_S", 0.0)
+            if stall_seconds is None
+            else float(stall_seconds)
+        )
+        self.queue_max_depth = (
+            _env_int("SR_QUEUE_MAX_DEPTH", 0)
+            if queue_max_depth is None
+            else int(queue_max_depth)
+        )
         self.fleet = bool(fleet)
         self.fleet_max = (
             int(os.environ.get("SR_FLEET_MAX", "8"))
@@ -118,7 +196,12 @@ class SearchServer:
         self.compilation_cache_dir = enable_persistent_compilation_cache(
             compilation_cache_dir
         )
-        self._own_spool = spool_dir is None
+        # with a journal, the spool must survive restarts (the engine's
+        # periodic snapshots there ARE the resume state) — default it into
+        # the journal dir instead of a shutdown-deleted tempdir
+        self._own_spool = spool_dir is None and self.journal_dir is None
+        if spool_dir is None and self.journal_dir is not None:
+            spool_dir = os.path.join(self.journal_dir, "spool")
         self.spool_dir = spool_dir or tempfile.mkdtemp(prefix="sr-serve-spool-")
         os.makedirs(self.spool_dir, exist_ok=True)
         self._queue = JobQueue(default_quota=default_quota, quotas=quotas)
@@ -128,9 +211,142 @@ class SearchServer:
         self._running: dict[str, Job] = {}
         self._warm_buckets: set = set()
         self._seq = 0
-        self._stopping = False
+        self._stop_event = threading.Event()
         self._workers: list[threading.Thread] = []
+        self._supervisor: threading.Thread | None = None
         self._started = False
+        self._retries = 0
+        self._quarantined = 0
+        self._shed = 0
+        self._stalls = 0
+        self._worker_restarts = 0
+        self._recovered = {
+            "queued": 0, "running": 0, "resumed": 0, "terminal": 0,
+            "dropped": 0,
+        }
+        self.journal = None
+        if self.journal_dir:
+            from .journal import JobJournal
+
+            self.journal = JobJournal(self.journal_dir)
+            self._recover()
+
+    @property
+    def _stopping(self) -> bool:
+        return self._stop_event.is_set()
+
+    # -- crash recovery --------------------------------------------------------
+    def _recover(self) -> None:
+        """Replay the journal and rebuild the job table: terminal jobs come
+        back as queryable shells (reported exactly once, never rerun),
+        queued/running searches re-enter the queue — a running job that left
+        an engine/preempt checkpoint in the spool resumes over it via the
+        same ``resume_from`` machinery preemption uses — and in-flight
+        subscriptions finalize CANCELLED (a live stream cannot be resumed
+        on behalf of a disconnected client)."""
+        state = self.journal.replay()
+        for job_id, st in sorted(state.items(), key=lambda kv: kv[1]["seq"]):
+            self._seq = max(self._seq, int(st.get("seq", 0)))
+            spec = None
+            if st.get("spec") is not None:
+                try:
+                    spec = pickle.loads(st["spec"])
+                except Exception:
+                    spec = None
+            if spec is None:
+                self._recovered["dropped"] += 1
+                continue
+            job = Job(job_id, spec, seq=int(st["seq"]))
+            job.submitted_at = float(st.get("submitted_at") or job.submitted_at)
+            job.deadline_at = (
+                None
+                if spec.deadline_seconds is None
+                else job.submitted_at + spec.deadline_seconds
+            )
+            job.attempts = int(st.get("attempts", 0))
+            job.iterations_done = int(st.get("iterations_done", 0))
+            job.not_before = float(st.get("not_before", 0.0))
+            job.error = st.get("error")
+            with self._lock:
+                self._jobs[job_id] = job
+            if st["state"] in q.TERMINAL_STATES:
+                job.state = st["state"]
+                job.finished_at = job.submitted_at
+                job.done_event.set()
+                self._recovered["terminal"] += 1
+                continue
+            if spec.kind != "search":
+                # a subscription's stream died with the old process; its
+                # client must resubscribe
+                job.error = job.error or "server restarted mid-subscription"
+                self._finalize(job, q.CANCELLED, release=False)
+                self._recovered["terminal"] += 1
+                continue
+            was_running = st["state"] == "running"
+            if self._adopt_checkpoint(job, st.get("ckpt")):
+                self._recovered["resumed"] += 1
+            self._recovered["running" if was_running else "queued"] += 1
+            if was_running:
+                # flip the journal's view back to queued (with the adopted
+                # checkpoint) so a second crash before this job runs again
+                # still recovers it
+                self._jappend(
+                    "requeue", job.id, attempts=job.attempts,
+                    not_before=0.0, ckpt=job.resume_path,
+                )
+            self._queue.submit(job)
+        self.journal.rotate()
+
+    def _adopt_checkpoint(self, job: Job, recorded: str | None) -> bool:
+        """Point ``job.resume_path`` at the freshest usable spool snapshot:
+        the engine's periodic checkpoint base first (newest ``.NNNNNN``
+        wins), then the journal-recorded path, then a preemption snapshot.
+        Also decides the resume REPORTING mode: an exact lockstep snapshot
+        resumes bit-exact and reports ABSOLUTE iterations (base 0), anything
+        else warm-starts over the remainder and reports run-relative."""
+        from ..utils.checkpoint import peek_checkpoint_meta
+
+        candidates = [os.path.join(self.spool_dir, f"{job.id}.engine")]
+        if recorded:
+            candidates.append(recorded)
+        candidates.append(os.path.join(self.spool_dir, f"{job.id}.ckpt"))
+        seen = set()
+        for cand in candidates:
+            if not cand or cand in seen:
+                continue
+            seen.add(cand)
+            try:
+                meta = peek_checkpoint_meta(cand)
+            except Exception:
+                continue
+            job.resume_path = meta["path"]
+            job.resumed_from_iteration = int(meta["iteration"])
+            job.iterations_done = max(
+                job.iterations_done, int(meta["iteration"])
+            )
+            job.resume_absolute = (
+                bool(meta["exact"])
+                and meta["scheduler"] == "lockstep"
+                and job.spec.options.scheduler == "lockstep"
+            )
+            return True
+        return False
+
+    def _jappend(self, type_: str, job_id: str, fsync: bool = True, **fields):
+        """Journal append that never takes the serve path down: on any
+        append failure (including an injected torn write) the log is
+        re-replayed, which truncates the torn tail so later appends land on
+        a clean frame boundary."""
+        jr = self.journal
+        if jr is None:
+            return
+        try:
+            jr.append(type_, job_id, fsync=fsync, **fields)
+        except Exception:
+            try:
+                jr.replay()
+            except Exception:
+                pass
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> "SearchServer":
@@ -143,12 +359,16 @@ class SearchServer:
             )
             t.start()
             self._workers.append(t)
+        self._supervisor = threading.Thread(
+            target=self._supervisor_loop, name="sr-serve-supervisor", daemon=True
+        )
+        self._supervisor.start()
         return self
 
     def shutdown(self, wait: bool = True, cancel_queued: bool = True) -> None:
         """Stop accepting work and stop running jobs at their next iteration
         boundary (cooperative; running jobs finalize as CANCELLED)."""
-        self._stopping = True
+        self._stop_event.set()
         with self._lock:
             running = list(self._running.values())
         for job in running:
@@ -160,12 +380,16 @@ class SearchServer:
                 self._finalize(job, q.CANCELLED, release=False)
         self._queue.wake_all()
         if wait:
-            for t in self._workers:
+            if self._supervisor is not None:
+                self._supervisor.join(timeout=60)
+            for t in list(self._workers):
                 t.join(timeout=60)
             if cancel_queued:
                 # a preempted job may have re-entered between drain and join
                 for job in self._queue.drain():
                     self._finalize(job, q.CANCELLED, release=False)
+        if self.journal is not None:
+            self.journal.close()
         if self._own_spool:
             shutil.rmtree(self.spool_dir, ignore_errors=True)
 
@@ -184,11 +408,26 @@ class SearchServer:
             raise RuntimeError("server is shutting down")
         if not self._started:
             raise RuntimeError("server not started (use start() or a with-block)")
+        if self.queue_max_depth and len(self._queue) >= self.queue_max_depth:
+            with self._lock:
+                self._shed += 1
+            raise ServerOverloaded(
+                f"queue depth at SR_QUEUE_MAX_DEPTH={self.queue_max_depth}; "
+                "resubmit later"
+            )
         with self._lock:
             self._seq += 1
             job_id = f"job-{self._seq:05d}"
             job = Job(job_id, spec, seq=self._seq)
             self._jobs[job_id] = job
+        if self.journal is not None:
+            try:
+                self.journal.append_submit(job)
+            except Exception:
+                try:
+                    self.journal.replay()
+                except Exception:
+                    pass
         self._queue.submit(job)
         self._maybe_preempt_for(job)
         return job_id
@@ -296,11 +535,22 @@ class SearchServer:
             for job in self._jobs.values():
                 by_state[job.state] = by_state.get(job.state, 0) + 1
             cache = self.cache.stats()
+            journal = {"enabled": self.journal is not None}
+            if self.journal is not None:
+                journal.update(self.journal.stats())
+                journal["dir"] = self.journal_dir
+                journal["recovered"] = dict(self._recovered)
             return {
                 "jobs": by_state,
                 "queued": len(self._queue),
                 "running": len(self._running),
                 "warm_buckets": len(self._warm_buckets),
+                "retries": self._retries,
+                "quarantined": self._quarantined,
+                "shed": self._shed,
+                "stalls": self._stalls,
+                "worker_restarts": self._worker_restarts,
+                "journal": journal,
                 "program_cache": cache,
                 "warm_hit_ratio": cache["hit_ratio"],
                 "compilation_cache_dir": self.compilation_cache_dir,
@@ -333,6 +583,8 @@ class SearchServer:
             victim.preempt_requested.set()
 
     def _worker_loop(self) -> None:
+        from ..utils import faults
+
         while not self._stopping:
             now = time.time()
             for job in self._queue.take_expired(now):
@@ -349,19 +601,65 @@ class SearchServer:
                 self._queue.release(job)
                 self._finalize(job, q.CANCELLED, release=False)
                 return
+            if faults.active().fire("worker_crash") is not None:
+                # thread death between acquire and run: give the job (and
+                # the tenant's quota slot) back, then die — the supervisor
+                # must restart this worker
+                self._queue.release(job)
+                self._queue.resubmit(job)
+                return
+            batch = [job]
             try:
                 if job.spec.kind == "subscription":
                     self._run_subscription(job)
                 else:
                     mates = self._gather_fleet(job)
                     if mates:
-                        self._run_fleet([job] + mates)
+                        batch = [job] + mates
+                        self._run_fleet(batch)
                     else:
                         self._run_job(job)
             except BaseException as e:  # a worker must never die silently
-                job.error = f"{type(e).__name__}: {e}"
-                self._queue.release(job)
-                self._finalize(job, q.FAILED, release=False)
+                # EVERY member of a coalesced batch is accounted for — the
+                # pre-r15 catch-all finalized only the lead job and left
+                # take_compatible mates in limbo forever
+                for member in batch:
+                    self._handle_run_failure(
+                        member, e, solo_retry=len(batch) > 1
+                    )
+
+    def _supervisor_loop(self) -> None:
+        """Self-healing sweep: restart worker threads that died (injected
+        ``worker_crash``, or a bug escaping the catch-all) and run the stall
+        watchdog — a RUNNING search whose iteration heartbeat has been
+        silent past ``SR_JOB_STALL_S`` gets a cooperative stop request and
+        retries from its latest checkpoint. Jobs that have never produced a
+        heartbeat are exempt (a first-touch compile legitimately takes
+        minutes)."""
+        interval = max(0.05, min(1.0, self.poll_seconds))
+        while not self._stop_event.wait(interval):
+            for i, t in enumerate(list(self._workers)):
+                if not t.is_alive() and not self._stopping:
+                    nt = threading.Thread(
+                        target=self._worker_loop, name=t.name, daemon=True
+                    )
+                    nt.start()
+                    self._workers[i] = nt
+                    with self._lock:
+                        self._worker_restarts += 1
+            if self.stall_s > 0:
+                now = time.time()
+                with self._lock:
+                    running = list(self._running.values())
+                for job in running:
+                    hb = job.heartbeat
+                    if (
+                        job.spec.kind == "search"
+                        and hb is not None
+                        and now - hb > self.stall_s
+                        and not job.stall_stop.is_set()
+                    ):
+                        job.stall_stop.set()
 
     def _warm_snapshot(self) -> set:
         with self._lock:
@@ -377,7 +675,34 @@ class SearchServer:
         spec = job.spec
 
         def _on_iteration(report) -> bool | None:
+            from ..utils import faults
+
+            job.heartbeat = time.time()
             job.iterations_done = job.iteration_base + report.iteration
+            hit = faults.active().fire("stall")
+            if hit is not None:
+                # a hung run: no heartbeat for delay_s — but poll the
+                # watchdog's stop request so the stall resolves the moment
+                # the supervisor notices it
+                end = time.time() + float(hit.get("delay_s", 30.0))
+                while time.time() < end:
+                    if (
+                        job.stall_stop.is_set()
+                        or job.cancel_requested.is_set()
+                        or self._stopping
+                    ):
+                        break
+                    time.sleep(0.02)
+            jr = self.journal
+            if jr is not None and spec.kind == "search":
+                nowt = time.time()
+                every = self.ckpt_every_s if self.ckpt_every_s > 0 else 5.0
+                if nowt - job.journal_progress_at >= every:
+                    job.journal_progress_at = nowt
+                    self._jappend(
+                        "progress", job.id, fsync=False,
+                        iterations_done=job.iterations_done,
+                    )
             if (
                 report.iteration % spec.stream_every == 0
                 or job.iterations_done >= spec.niterations
@@ -402,7 +727,12 @@ class SearchServer:
                 if group
                 else job.cancel_requested.is_set()
             )
-            if cancelled or job.preempt_requested.is_set() or self._stopping:
+            if (
+                cancelled
+                or job.preempt_requested.is_set()
+                or job.stall_stop.is_set()
+                or self._stopping
+            ):
                 return True
             return None
 
@@ -410,6 +740,7 @@ class SearchServer:
 
     def _run_job(self, job: Job, group=None) -> None:
         from ..search import equation_search
+        from ..utils import faults
         from ..utils.checkpoint import options_fingerprint
 
         spec = job.spec
@@ -421,11 +752,27 @@ class SearchServer:
         with self._lock:
             self._running[job.id] = job
         job.started_at = job.started_at or now
-        job.iteration_base = job.iterations_done
+        job.heartbeat = None
+        job.stall_stop.clear()
+        # exact lockstep resumes run [start_iter, niterations) and report
+        # ABSOLUTE iterations; warm-start resumes run the remainder and
+        # report run-relative — only the latter needs the base offset
+        job.iteration_base = 0 if job.resume_absolute else job.iterations_done
+        if group is None:
+            job.attempts += 1
 
+        ckpt_base = None
+        if self.journal is not None:
+            ckpt_base = os.path.join(self.spool_dir, f"{job.id}.engine")
+            if group is None:
+                self._jappend(
+                    "start", job.id, attempts=job.attempts, ckpt=ckpt_base
+                )
         fingerprint = options_fingerprint(spec.options)
-        opts = self._lane_options(job, fingerprint, now, group)
+        opts = self._lane_options(job, fingerprint, now, group, ckpt_base)
         try:
+            if faults.active().fire("job_exception") is not None:
+                raise faults.FaultInjected("injected job_exception")
             result = equation_search(
                 spec.X,
                 spec.y,
@@ -436,16 +783,21 @@ class SearchServer:
                 verbosity=0,
             )
         except BaseException as e:
-            self._release_running(job)
-            job.error = f"{type(e).__name__}: {e}"
-            self._finalize(job, q.FAILED, release=False)
+            self._handle_run_failure(job, e)
             return
 
         self._complete_lane(job, result, fingerprint)
 
-    def _lane_options(self, job: Job, fingerprint: tuple, now: float, group=None):
+    def _lane_options(
+        self, job: Job, fingerprint: tuple, now: float, group=None,
+        ckpt_base: str | None = None,
+    ):
         """The server's per-run Options replacement — shared by the solo and
-        fleet paths so a coalesced job behaves exactly like a solo one."""
+        fleet paths so a coalesced job behaves exactly like a solo one.
+        ``ckpt_base`` (journaled solo runs only) re-enables the engine's own
+        periodic checkpointer pointed into the spool: those snapshots are
+        what crash recovery resumes from, bounding work loss to one
+        ``SR_SERVE_CKPT_EVERY_S`` interval."""
         spec = job.spec
         timeout = spec.options.timeout_in_seconds
         if job.deadline_at is not None:
@@ -460,12 +812,21 @@ class SearchServer:
                 if spec.max_evals is not None
                 else spec.options.max_evals
             ),
-            # the server owns persistence: no CSV sidecars, no per-job
-            # checkpoint cadence (preemption snapshots are written here)
+            # the server owns persistence: no CSV sidecars, and the only
+            # checkpoint cadence is the durability one the journal wires in
+            # (preemption snapshots are written here either way)
             save_to_file=False,
             progress=False,
             checkpoint_every=None,
-            checkpoint_every_seconds=None,
+            checkpoint_every_seconds=(
+                self.ckpt_every_s
+                if ckpt_base and self.ckpt_every_s > 0
+                else None
+            ),
+            checkpoint_file=(
+                ckpt_base if ckpt_base else spec.options.checkpoint_file
+            ),
+            checkpoint_keep=2 if ckpt_base else spec.options.checkpoint_keep,
         )
 
     def _complete_lane(self, job: Job, result, fingerprint: tuple) -> None:
@@ -482,6 +843,19 @@ class SearchServer:
             return
         if job.stop_reason == "callback" and job.preempt_requested.is_set():
             self._preempt_requeue(job, result, fingerprint)
+            return
+        if job.stop_reason == "callback" and job.stall_stop.is_set():
+            # the stall watchdog stopped this run cooperatively: snapshot
+            # what it had and send it through the retry path
+            with self._lock:
+                self._stalls += 1
+            job.error = (
+                "StallDetected: no iteration heartbeat for > "
+                f"{self.stall_s:.2f}s"
+            )
+            job.resume_path = self._spool_snapshot(job, result, fingerprint)
+            job.resume_absolute = False
+            self._retry_or_quarantine(job, adopt=False)
             return
         # definitive final frame from the FINISHED result: the pipelined
         # device loop's per-iteration reports lag the hall of fame by one
@@ -514,6 +888,8 @@ class SearchServer:
             self._running[job.id] = job
         job.started_at = job.started_at or time.time()
         job.iteration_base = job.iterations_done
+        job.attempts += 1
+        self._jappend("start", job.id, attempts=job.attempts)
 
         def _on_frame(frame: bytes) -> None:
             with self._frame_cond:
@@ -545,7 +921,8 @@ class SearchServer:
             )
         except BaseException as e:
             self._release_running(job)
-            job.error = f"{type(e).__name__}: {e}"
+            job.error = _format_error(e)
+            job.traceback = _format_traceback(e)
             self._finalize(job, q.FAILED, release=False)
             return
         with self._lock:
@@ -560,7 +937,8 @@ class SearchServer:
             result = session.run()
         except BaseException as e:
             self._release_running(job)
-            job.error = f"{type(e).__name__}: {e}"
+            job.error = _format_error(e)
+            job.traceback = _format_traceback(e)
             self._finalize(job, q.FAILED, release=False)
             return
 
@@ -591,11 +969,13 @@ class SearchServer:
         if (
             lead.deadline_at is not None
             or lead.resume_path is not None
+            or lead.solo_only
             or lead.cancel_requested.is_set()
         ):
             # deadline-urgent jobs bypass coalescing (their wall budget must
             # not be hostage to fleet drain); preemption resumes warm-start
-            # solo (fleet lanes take no saved_state)
+            # solo (fleet lanes take no saved_state); a job retried after a
+            # fleet failure is isolated from coalescing for good
             return []
         from ..models.device_search import fleet_eligibility
 
@@ -610,7 +990,9 @@ class SearchServer:
         limit = self.fleet_max - 1
         mates = self._queue.take_compatible(lead, limit)
         if len(mates) < limit and self.fleet_window_s > 0:
-            time.sleep(self.fleet_window_s)
+            # interruptible admission window: shutdown must not hang a
+            # worker for the full straggler wait
+            self._stop_event.wait(self.fleet_window_s)
             mates += self._queue.take_compatible(lead, limit - len(mates))
         return mates
 
@@ -673,9 +1055,13 @@ class SearchServer:
             elif leader.state == q.FAILED:
                 self._release_running(f)
                 f.error = leader.error
+                f.traceback = leader.traceback
                 self._finalize(f, q.FAILED, release=False)
             else:
                 self._release_running(f)
+                if leader.error is not None:
+                    # the shared run broke: riders rerun solo, isolated
+                    f.solo_only = True
                 self._queue.resubmit(f)
 
     def _run_fleet(self, jobs: list[Job]) -> None:
@@ -705,7 +1091,11 @@ class SearchServer:
             self._fleet_deduped += len(jobs) - len(groups)
         for job in jobs:
             job.started_at = job.started_at or now
+            job.heartbeat = None
+            job.stall_stop.clear()
             job.iteration_base = job.iterations_done
+            job.attempts += 1
+            self._jappend("start", job.id, attempts=job.attempts)
 
         if len(groups) == 1:
             leader, followers = jobs[0], jobs[1:]
@@ -745,14 +1135,14 @@ class SearchServer:
                 lane_bucket=self.fleet_max,
             )
         except BaseException as e:
-            err = f"{type(e).__name__}: {e}"
+            # fleet failure isolation: an exception in the coalesced batch
+            # must not FAIL every incomplete lane — each member retries solo
+            # (solo_only, so it never re-enters a coalesced batch)
             for flag, group in zip(completed, groups):
                 if flag:
                     continue
                 for job in group:
-                    self._release_running(job)
-                    job.error = err
-                    self._finalize(job, q.FAILED, release=False)
+                    self._handle_run_failure(job, e, solo_retry=True)
 
     def _push_final_frame(self, job: Job, result, fingerprint: tuple) -> None:
         from ..utils.checkpoint import dump_frontier_bytes
@@ -779,10 +1169,11 @@ class SearchServer:
             self._warm_buckets.add(job.bucket)
         self._queue.release(job)
 
-    def _preempt_requeue(self, job: Job, result, fingerprint: tuple) -> None:
-        """Snapshot the evicted job's state (format-2, atomic write) and
-        re-enqueue it: the next admission resumes via ``resume_from`` over
-        the remaining ``niterations - iterations_done`` budget."""
+    def _spool_snapshot(self, job: Job, result, fingerprint: tuple) -> str:
+        """Write a format-2 snapshot of a cooperatively-stopped run into the
+        spool (atomic tmp+fsync+rename): the resume artifact for preemption
+        and for stall retries. ``exact=False``: a decoded observation, so the
+        next run rescores and warm-starts over the remaining budget."""
         from ..utils.checkpoint import SearchCheckpoint, dump_checkpoint_bytes
 
         ck = SearchCheckpoint(
@@ -804,12 +1195,78 @@ class SearchServer:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        job.resume_path = path
+        return path
+
+    def _preempt_requeue(self, job: Job, result, fingerprint: tuple) -> None:
+        """Snapshot the evicted job's state (format-2, atomic write) and
+        re-enqueue it: the next admission resumes via ``resume_from`` over
+        the remaining ``niterations - iterations_done`` budget."""
+        job.resume_path = self._spool_snapshot(job, result, fingerprint)
+        job.resume_absolute = False
         job.preemptions += 1
         job.preempt_requested.clear()
         with self._lock:
             job.state = q.PREEMPTED
+        self._jappend(
+            "requeue", job.id, attempts=job.attempts, not_before=0.0,
+            ckpt=job.resume_path,
+        )
         self._queue.resubmit(job)
+
+    def _handle_run_failure(
+        self, job: Job, exc: BaseException, solo_retry: bool = False
+    ) -> None:
+        """Route one job whose run raised ``exc``: cancelled/stopping jobs
+        finalize, subscriptions FAIL (a live stream has no resumable
+        budget), searches go through retry-with-backoff escalating to
+        QUARANTINED. No-op for jobs already finalized or already requeued —
+        the worker loop's batch-wide catch-all may revisit members an inner
+        handler dealt with."""
+        if job.terminal or job.state in (q.QUEUED, q.PREEMPTED):
+            return
+        job.error = _format_error(exc)
+        job.traceback = _format_traceback(exc)
+        self._release_running(job)
+        if job.cancel_requested.is_set():
+            self._finalize(job, q.CANCELLED, release=False)
+            return
+        if self._stopping or job.spec.kind != "search":
+            self._finalize(job, q.FAILED, release=False)
+            return
+        self._retry_or_quarantine(job, solo_only=solo_retry)
+
+    def _retry_or_quarantine(
+        self, job: Job, solo_only: bool = False, adopt: bool = True
+    ) -> None:
+        """Requeue a failed search with exponential backoff, resuming from
+        the freshest spool checkpoint when one exists; once its attempts
+        exceed ``SR_JOB_RETRIES`` the job is a poison job and terminates
+        QUARANTINED."""
+        if job.attempts > self.job_retries:
+            with self._lock:
+                self._quarantined += 1
+            self._finalize(job, q.QUARANTINED, release=False)
+            return
+        with self._lock:
+            self._retries += 1
+        if solo_only:
+            job.solo_only = True
+        job.stall_stop.clear()
+        job.heartbeat = None
+        job.not_before = time.time() + self.retry_backoff_s * (
+            2 ** max(0, job.attempts - 1)
+        )
+        if adopt and not self._adopt_checkpoint(job, job.resume_path):
+            # nothing to resume from: the retry is a clean restart
+            job.resume_path = None
+            job.resume_absolute = False
+            job.iterations_done = 0
+        self._jappend(
+            "requeue", job.id, attempts=job.attempts,
+            not_before=job.not_before, error=job.error, ckpt=job.resume_path,
+        )
+        self._queue.resubmit(job)
+        self._queue.wake_all()
 
     def _finalize(self, job: Job, state: str, release: bool = True) -> None:
         if release:
@@ -818,4 +1275,21 @@ class SearchServer:
             job.state = state
             job.finished_at = time.time()
             self._frame_cond.notify_all()
+        if self.journal is not None:
+            self._jappend("terminal", job.id, state=state, error=job.error)
+            self._clean_spool(job)
         job.done_event.set()
+
+    def _clean_spool(self, job: Job) -> None:
+        """Drop a terminal job's spool artifacts (preempt snapshot + the
+        engine checkpoint chain) — nothing will ever resume them."""
+        from ..utils.checkpoint import _list_snapshots
+
+        base = os.path.join(self.spool_dir, f"{job.id}.engine")
+        paths = [p for _, p in _list_snapshots(base)]
+        paths.append(os.path.join(self.spool_dir, f"{job.id}.ckpt"))
+        for p in paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass

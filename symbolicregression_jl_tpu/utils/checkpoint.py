@@ -55,6 +55,7 @@ __all__ = [
     "SearchCheckpointer",
     "latest_checkpoint",
     "load_checkpoint",
+    "peek_checkpoint_meta",
     "dump_checkpoint_bytes",
     "load_checkpoint_bytes",
     "FrontierUpdate",
@@ -542,6 +543,54 @@ def load_checkpoint(path: str) -> SearchCheckpoint:
         except CheckpointError as e:
             raise CheckpointError(f"snapshot {target!r}: {e}") from e
     return ckpt
+
+
+def peek_checkpoint_meta(path: str) -> dict:
+    """Resolve ``path`` like :func:`load_checkpoint` (file or base → newest
+    ``{base}.NNNNNN`` snapshot) and return its METADATA without decoding or
+    verifying the populations — the serve layer's crash recovery needs
+    iteration/scheduler/exactness to plan a resume for many jobs at once,
+    and full decode+verify happens anyway when the job actually resumes.
+
+    Returns ``{"path", "iteration", "niterations", "scheduler", "exact",
+    "format_version"}``; raises :class:`FileNotFoundError` when nothing
+    exists at ``path`` and :class:`CheckpointError` when the snapshot cannot
+    even be unpickled into a SearchCheckpoint shell."""
+    target = path
+    if not os.path.isfile(target):
+        latest = latest_checkpoint(path)
+        if latest is None:
+            raise FileNotFoundError(
+                f"no checkpoint at {path!r} (nor any {path}.NNNNNN snapshot)"
+            )
+        target = latest
+    try:
+        with open(target, "rb") as f:
+            ckpt = pickle.load(f)
+    except (
+        pickle.PickleError,
+        EOFError,
+        AttributeError,
+        ImportError,
+        IndexError,
+        ValueError,
+        TypeError,
+        UnicodeDecodeError,
+        OSError,
+    ) as e:
+        raise CheckpointError(
+            f"cannot unpickle snapshot {target!r}: truncated or corrupt ({e})"
+        ) from e
+    if not isinstance(ckpt, SearchCheckpoint):
+        raise CheckpointError(f"{target!r} is not a SearchCheckpoint snapshot")
+    return {
+        "path": target,
+        "iteration": int(ckpt.iteration),
+        "niterations": int(ckpt.niterations),
+        "scheduler": ckpt.scheduler,
+        "exact": bool(ckpt.exact),
+        "format_version": int(ckpt.format_version),
+    }
 
 
 def dump_checkpoint_bytes(ckpt: SearchCheckpoint) -> bytes:

@@ -41,6 +41,20 @@ Fault sites (each scheduler documents which it consults):
 - ``slow_peer`` — the process sleeps ``delay_ms`` (default 1000) before
   posting its exchange payload, a straggler rather than a death: peers
   must absorb it inside the shared deadline with no membership change.
+- ``worker_crash`` — a ``SearchServer`` worker thread dies at the top of
+  its loop (after acquiring a job, before running it); the job is requeued
+  and the supervisor thread must restart the worker.
+- ``job_exception`` — the serve layer's per-job run raises
+  :class:`FaultInjected` just before the engine is entered, exercising the
+  transient-retry / quarantine escalation path.
+- ``journal_torn_write`` — the serve ``JobJournal`` writes only HALF of one
+  CRC-framed record (flushed) and raises, leaving exactly the torn tail
+  that replay must truncate cleanly.
+- ``stall`` — the serve iteration callback blocks for ``delay_s`` (default
+  30) without a heartbeat, simulating a hung run; the ``SR_JOB_STALL_S``
+  watchdog must detect the frozen ``iterations_done``, request cooperative
+  stop, and retry the job (the sleep polls the stop request, so the stall
+  resolves the moment the watchdog fires).
 
 One injector is active per process at a time: ``install()`` (called by the
 schedulers when ``Options.fault_spec`` is set, resetting call counts) takes
@@ -73,6 +87,10 @@ FAULT_SITES = (
     "peer_join",
     "kv_flap",
     "slow_peer",
+    "worker_crash",
+    "job_exception",
+    "journal_torn_write",
+    "stall",
 )
 
 
