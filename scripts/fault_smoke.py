@@ -26,10 +26,19 @@ Three end-to-end cycles through the fault-tolerant runtime, minutes not hours:
    from its checkpoint, and land a frontier bit-identical to an
    uninterrupted run. Also exercises in-process: transient ``job_exception``
    retried to DONE and a persistent one escalated to QUARANTINED.
+5. **Pod federation**: two ``PodNode`` subprocesses over a shared
+   FileCoordStore serve a mixed queued/running workload; one host is
+   SIGKILLed mid-batch with an exact lockstep snapshot on disk. The
+   survivor must claim the dead host's journal generation, adopt every
+   job (zero lost, zero duplicated — the write-once done ledger is the
+   proof), and resume the running lockstep job BIT-IDENTICALLY to an
+   uninterrupted run. Then a third host takes jobs and gets SIGTERM:
+   graceful drain must checkpoint its lanes, publish a retirement
+   marker, exit 0, and hand the jobs off to the survivor.
 
 Exits nonzero on the first violated invariant. Usage: python
-scripts/fault_smoke.py [checkpoint|exchange|elastic|serve] (CI passes no
-args = all; JAX_PLATFORMS=cpu is forced).
+scripts/fault_smoke.py [checkpoint|exchange|elastic|serve|pod] (CI passes
+no args = all; JAX_PLATFORMS=cpu is forced).
 """
 
 from __future__ import annotations
@@ -503,12 +512,252 @@ def smoke_serve_durability() -> None:
     )
 
 
+_POD_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+host, coord = sys.argv[1], sys.argv[2]
+os.environ["SR_COORD_DIR"] = coord
+
+from symbolicregression_jl_tpu.parallel.membership import FileCoordStore
+from symbolicregression_jl_tpu.serve import PodNode
+
+# one lane per host: the bit-exact migrated-frontier check needs the long
+# lockstep job to run solo on both sides (concurrent engine runs in one
+# process perturb each other's trajectory)
+node = PodNode(host, store=FileCoordStore(coord), hb_seconds=0.1,
+               suspect_seconds=1.5, max_concurrency=1, poll_seconds=0.02,
+               ckpt_every_s=0.1)
+node.install_sigterm_drain()
+node.start()
+print("READY " + host, flush=True)
+time.sleep(3600)  # serve until the parent SIGKILLs or SIGTERMs us
+"""
+
+
+def smoke_pod_federation() -> None:
+    import glob
+    import pickle
+    import signal
+    import time
+
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.parallel.membership import FileCoordStore
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, PodClient
+    from symbolicregression_jl_tpu.utils.checkpoint import load_frontier_bytes
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+
+    def opts(seed=0):
+        return Options(
+            binary_operators=["+", "-", "*"], unary_operators=["cos"],
+            populations=2, population_size=12, ncycles_per_iteration=8,
+            maxsize=12, seed=seed, scheduler="lockstep", save_to_file=False,
+        )
+
+    def frame_frontier(frame, options):
+        upd = load_frontier_bytes(frame)
+        return ";".join(
+            f"{m.get_complexity(options)}:{m.loss:.17g}"
+            for m in sorted(
+                upd.members, key=lambda m: m.get_complexity(options)
+            )
+        )
+
+    o = opts()
+    reference = equation_search(X, y, options=opts(), niterations=40,
+                                verbosity=0)
+    ref_front = _frontier(reference, o)
+
+    with tempfile.TemporaryDirectory() as d:
+        coord = os.path.join(d, "coord")
+        script = os.path.join(d, "pod_child.py")
+        with open(script, "w") as f:
+            f.write(_POD_CHILD.format(repo=REPO))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("SR_POD_ID", None)
+
+        def launch(host):
+            p = subprocess.Popen(
+                [sys.executable, script, host, coord],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=REPO,
+            )
+            for line in p.stdout:
+                if line.startswith("READY"):
+                    return p
+            raise SystemExit(f"FAIL: pod child {host} never came up")
+
+        store = FileCoordStore(coord)
+        client = PodClient(store=store, suspect_seconds=1.5)
+        procs = {h: launch(h) for h in ("h0", "h1")}
+        deadline = time.time() + 60
+        while {"h0", "h1"} - set(client.live_hosts()):
+            if time.time() > deadline:
+                raise SystemExit("FAIL: hosts never advertised")
+            time.sleep(0.05)
+
+        # --- kill drill: mixed queued + running workload on the victim ------
+        # the long lockstep job is pinned to h1 (the victim) FIRST so it
+        # grabs the single worker slot and starts snapshotting (exact engine
+        # frames every 0.1s); three shorts queue behind it so h1 dies with
+        # a running AND queued jobs; two more route freely
+        long_id = client.submit(
+            JobSpec(X, y, options=opts(), niterations=40), host="h1"
+        )
+        free = [
+            client.submit(JobSpec(X, y, options=opts(seed=s), niterations=2))
+            for s in (1, 2)
+        ]
+        pinned = [
+            client.submit(
+                JobSpec(X, y, options=opts(seed=10 + s), niterations=4),
+                host="h1",
+            )
+            for s in range(3)
+        ]
+        all_ids = free + pinned + [long_id]
+
+        # map the long pod job to the victim's LOCAL job id through its
+        # journal (shared fs), then wait for one of ITS exact snapshots —
+        # killing before the long job runs would degrade the drill to a
+        # queued-job migration
+        from symbolicregression_jl_tpu.serve import JobJournal
+
+        jdir = os.path.join(coord, "_pod", "h1", "gen-0001")
+        spool = os.path.join(jdir, "spool")
+        local_long = None
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if local_long is None and os.path.isdir(jdir):
+                jr = JobJournal(jdir)
+                try:
+                    for jid, st in jr.replay().items():
+                        if st.get("spec") is None:
+                            continue
+                        spec = pickle.loads(st["spec"])
+                        if getattr(spec, "label", "") == long_id:
+                            local_long = jid
+                finally:
+                    jr.close()
+            if local_long is not None and glob.glob(
+                os.path.join(spool, local_long + ".engine.*")
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise SystemExit(
+                "FAIL: victim's long job never wrote an exact engine snapshot"
+            )
+        procs["h1"].send_signal(signal.SIGKILL)
+        procs["h1"].wait(timeout=60)
+
+        recs = client.wait_all(all_ids, timeout=600)
+        ledger = client.results()
+        if set(ledger) != set(all_ids):
+            raise SystemExit(
+                f"FAIL: done ledger {sorted(ledger)} != submitted "
+                f"{sorted(all_ids)} (lost or phantom jobs)"
+            )
+        bad = {p: r["state"] for p, r in recs.items() if r["state"] != DONE}
+        if bad:
+            raise SystemExit(f"FAIL: non-DONE after migration: {bad}")
+        lrec = recs[long_id]
+        if lrec["host"] != "h0":
+            raise SystemExit(
+                f"FAIL: long job finished on {lrec['host']}, not the survivor"
+            )
+        if not lrec["resumed_from_iteration"]:
+            raise SystemExit(
+                "FAIL: migrated running job restarted from scratch instead "
+                f"of resuming: {lrec}"
+            )
+        front = frame_frontier(lrec["final_frame"], o)
+        if front != ref_front:
+            raise SystemExit(
+                "FAIL: migrated lockstep job's frontier differs from the "
+                f"uninterrupted run\n  full:     {ref_front}"
+                f"\n  migrated: {front}"
+            )
+        survivor_ad = client.hosts()["h0"]
+        if survivor_ad["duplicate_results"] != 0:
+            raise SystemExit(
+                f"FAIL: {survivor_ad['duplicate_results']} duplicate "
+                "result(s) published after migration"
+            )
+        resumed_at = lrec["resumed_from_iteration"]
+
+        # --- drain drill: SIGTERM hands lanes off, exit 0, fast adoption ----
+        procs["h2"] = launch("h2")
+        deadline = time.time() + 60
+        while "h2" not in client.live_hosts():
+            if time.time() > deadline:
+                raise SystemExit("FAIL: h2 never advertised")
+            time.sleep(0.05)
+        drain_ids = [
+            client.submit(
+                JobSpec(X, y, options=opts(seed=20 + s), niterations=4),
+                host="h2",
+            )
+            for s in range(2)
+        ]
+        # wait until h2 owns them (inbox consumed into its journal)
+        deadline = time.time() + 120
+        while True:
+            ad = client.hosts().get("h2", {})
+            owned = ad.get("queue_depth", 0) + ad.get("running", 0)
+            settled = sum(1 for p in drain_ids if client.done(p) is not None)
+            if owned + settled >= len(drain_ids):
+                break
+            if time.time() > deadline:
+                raise SystemExit("FAIL: h2 never consumed its inbox")
+            time.sleep(0.02)
+        t_term = time.time()
+        procs["h2"].send_signal(signal.SIGTERM)
+        if procs["h2"].wait(timeout=120) != 0:
+            raise SystemExit("FAIL: SIGTERM drain exited nonzero")
+        claim_key = "srpod/pod0/claim/h2/gen-0001"
+        retire_key = "srpod/pod0/retire/h2/gen-0001"
+        if store.try_get(retire_key) is None:
+            raise SystemExit("FAIL: drained host left no retirement marker")
+        deadline = time.time() + 60
+        while store.try_get(claim_key) is None:
+            if time.time() > deadline:
+                raise SystemExit("FAIL: survivor never adopted the drained gen")
+            time.sleep(0.01)
+        handoff_s = time.time() - t_term
+        recs = client.wait_all(drain_ids, timeout=600)
+        bad = {p: r["state"] for p, r in recs.items() if r["state"] != DONE}
+        if bad:
+            raise SystemExit(f"FAIL: non-DONE after drain handoff: {bad}")
+        if client.hosts()["h0"]["duplicate_results"] != 0:
+            raise SystemExit("FAIL: duplicate result(s) after drain handoff")
+        if set(client.results()) != set(all_ids + drain_ids):
+            raise SystemExit("FAIL: done ledger drifted after drain")
+
+        procs["h0"].send_signal(signal.SIGKILL)
+        procs["h0"].wait(timeout=60)
+    print(
+        f"OK pod federation: SIGKILL'd host's {len(pinned) + 1} jobs migrated "
+        f"(running lockstep job resumed at iteration {resumed_at}, frontier "
+        f"bit-exact), {len(all_ids)}/{len(all_ids)} terminal with zero "
+        f"duplicates; SIGTERM drain handed off {len(drain_ids)} jobs in "
+        f"{handoff_s:.2f}s"
+    )
+
+
 if __name__ == "__main__":
     which = set(sys.argv[1:]) or {"all"}
-    unknown = which - {"all", "checkpoint", "exchange", "elastic", "serve"}
+    unknown = which - {"all", "checkpoint", "exchange", "elastic", "serve",
+                       "pod"}
     if unknown:
         sys.exit(f"unknown cycle(s): {sorted(unknown)} "
-                 "(choose from: checkpoint exchange elastic serve)")
+                 "(choose from: checkpoint exchange elastic serve pod)")
     if which & {"all", "checkpoint"}:
         smoke_checkpoint_resume()
     if which & {"all", "exchange"}:
@@ -517,4 +766,6 @@ if __name__ == "__main__":
         smoke_elastic_rejoin()
     if which & {"all", "serve"}:
         smoke_serve_durability()
+    if which & {"all", "pod"}:
+        smoke_pod_federation()
     print("FAULT_SMOKE=pass")

@@ -177,22 +177,35 @@ def live_set_digest(epoch: int, seq: int, live) -> str:
 
 
 class PeerLossError(RuntimeError):
-    """A peer failed to post its exchange payload before the deadline.
-    Carries the allgather sequence id, the missing process ids, and the
-    number of poll attempts made under the retry/backoff schedule."""
+    """A peer failed to post its exchange payload (or reach a barrier)
+    before the deadline. Carries the allgather sequence id (-1 for
+    barriers), the missing process ids, and the number of poll attempts
+    made under the retry/backoff schedule. ``phase`` overrides the
+    "allgather seq N" message lead for non-gather collectives (the
+    CoordStore barrier names itself here) — the missing-id payload is the
+    contract either way."""
 
-    def __init__(self, seq: int, missing, timeout_ms: int, attempts: int | None = None):
+    def __init__(
+        self,
+        seq: int,
+        missing,
+        timeout_ms: int,
+        attempts: int | None = None,
+        phase: str | None = None,
+    ):
         self.seq = int(seq)
         self.missing = tuple(sorted(int(p) for p in missing))
         self.attempts = None if attempts is None else int(attempts)
+        self.phase = phase
         peers = ", ".join(str(p) for p in self.missing)
         tried = (
             f" after {self.attempts} poll attempt(s)"
             if self.attempts is not None
             else ""
         )
+        lead = phase if phase is not None else f"allgather seq {self.seq}"
         super().__init__(
-            f"allgather seq {self.seq}: process(es) {peers} failed to post "
+            f"{lead}: process(es) {peers} failed to post "
             f"within {timeout_ms} ms (SR_KV_TIMEOUT_MS){tried}; set "
             "on_peer_loss='continue' to keep searching on the survivors"
         )

@@ -66,6 +66,7 @@ __all__ = [
     "FileCoordStore",
     "JaxCoordStore",
     "coord_store",
+    "coord_gc_seconds",
     "elastic_enabled",
     "join_pending",
     "should_use_group",
@@ -75,6 +76,31 @@ __all__ = [
 
 
 # -- coordination stores ------------------------------------------------------
+
+
+def coord_gc_seconds() -> float:
+    """``SR_COORD_GC_S``: TTL past which unprotected coordination keys are
+    swept by :meth:`FileCoordStore.gc`. 0 (the default) disables the sweep.
+    Read per sweep — a live pod honors changes."""
+    try:
+        return float(os.environ.get("SR_COORD_GC_S", "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+# Keys that must outlive any TTL: epoch records are the membership history a
+# late joiner replays (srep/), checkpoint shards are a joiner's warm start
+# (srshard/), and pod adoption leases / retirement markers are the
+# exactly-once guard for journal takeover — sweeping a lease would let a
+# second survivor re-adopt (and re-run) a dead host's jobs.
+_GC_PROTECTED_PREFIXES = ("srep/", "srshard/")
+_GC_PROTECTED_PARTS = ("/claim/", "/retire/")
+
+
+def _gc_protected(key: str) -> bool:
+    return key.startswith(_GC_PROTECTED_PREFIXES) or any(
+        part in key for part in _GC_PROTECTED_PARTS
+    )
 
 
 class CoordStore:
@@ -87,6 +113,12 @@ class CoordStore:
         """Overwrite-capable set (heartbeats)."""
         raise NotImplementedError
 
+    def set_if_absent(self, key: str, value: bytes) -> bool:
+        """Atomic write-once claim: True iff THIS call created the key.
+        The pod runtime's adoption leases ride on this — exactly one
+        survivor wins the right to replay a dead host's journal."""
+        raise NotImplementedError
+
     def get(self, key: str, timeout_ms: int) -> bytes:
         """Blocking read; raises TimeoutError past the deadline."""
         raise NotImplementedError
@@ -97,8 +129,37 @@ class CoordStore:
     def delete(self, key: str) -> None:
         raise NotImplementedError
 
-    def barrier(self, bid: str, timeout_ms: int, ids: list[int], my_id: int) -> None:
+    def list(self, prefix: str) -> list[str]:
+        """Sorted keys under ``prefix``. Best-effort (a concurrent
+        delete may leave a listed key unreadable — callers re-check with
+        ``try_get``)."""
         raise NotImplementedError
+
+    def barrier(self, bid: str, timeout_ms: int, ids: list[int], my_id: int) -> None:
+        """KV-poll barrier: post my arrival under ``{bid}/{my_id}``, then
+        poll every other id's key against one shared deadline. On expiry
+        raises :class:`dist.PeerLossError` naming EVERY id that never
+        arrived — survivors of a mid-barrier death get the full missing
+        set within the deadline instead of hanging (or learning one rank
+        at a time)."""
+        # NB: the arrival marker must be >1 byte — jax 0.4.37's
+        # blocking_key_value_get_bytes SEGFAULTS reading a 1-byte value
+        # (2+ bytes round-trip fine), so b"1" here would crash every
+        # peer that polls the key on the coordination-service transport
+        self.set_mutable(f"{bid}/{my_id}", b"arrived")
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        pending = [p for p in ids if p != my_id]
+        while pending:
+            pending = [
+                p for p in pending if self.try_get(f"{bid}/{p}") is None
+            ]
+            if not pending:
+                return
+            if time.monotonic() >= deadline:
+                raise dist.PeerLossError(
+                    -1, pending, timeout_ms, phase=f"barrier {bid}"
+                )
+            time.sleep(0.01)
 
 
 class FileCoordStore(CoordStore):
@@ -111,6 +172,7 @@ class FileCoordStore(CoordStore):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        self._gc_at = 0.0
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, urllib.parse.quote(key, safe=""))
@@ -145,33 +207,116 @@ class FileCoordStore(CoordStore):
         except OSError:
             return None
 
+    def set_if_absent(self, key: str, value: bytes) -> bool:
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            # hard-link is the atomic "create iff absent" on a shared fs
+            # (os.replace would silently overwrite a racing claimant)
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        finally:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
     def delete(self, key: str) -> None:
         try:
             os.remove(self._path(key))
         except OSError:
             pass
 
-    def barrier(self, bid: str, timeout_ms: int, ids: list[int], my_id: int) -> None:
-        self.set(f"{bid}/{my_id}", b"1")
-        deadline = time.monotonic() + timeout_ms / 1000.0
-        for p in ids:
-            while self.try_get(f"{bid}/{p}") is None:
-                if time.monotonic() >= deadline:
-                    raise TimeoutError(f"barrier {bid}: rank {p} never arrived")
-                time.sleep(0.01)
+    def list(self, prefix: str) -> list[str]:
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return []
+        out = []
+        for fn in entries:
+            if ".tmp." in fn:  # in-flight atomic write (or a crash orphan)
+                continue
+            if os.path.isdir(os.path.join(self.root, fn)):
+                continue
+            key = urllib.parse.unquote(fn)
+            if key.startswith(prefix):
+                out.append(key)
+        return sorted(out)
+
+    def gc(self, ttl_s: float | None = None) -> int:
+        """TTL sweep (satellite r16): heartbeat/gather/barrier keys are
+        written forever by long-lived groups and pods, and nothing ever
+        reclaims the ones a crashed process left behind — sweep every
+        unprotected key whose mtime is older than ``ttl_s`` (default
+        ``SR_COORD_GC_S``; 0 disables). Epoch records, checkpoint shards,
+        and pod leases/retire markers are exempt (see ``_gc_protected``).
+        Env-driven calls (``ttl_s=None``) self-throttle to one sweep per
+        quarter-TTL so heartbeat loops can call this every beat for free.
+        Returns the number of keys removed."""
+        ttl = coord_gc_seconds() if ttl_s is None else float(ttl_s)
+        if ttl <= 0:
+            return 0
+        now = time.time()
+        if ttl_s is None and now - self._gc_at < max(1.0, ttl / 4.0):
+            return 0
+        self._gc_at = now
+        removed = 0
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return 0
+        for fn in entries:
+            path = os.path.join(self.root, fn)
+            key = urllib.parse.unquote(fn)
+            if ".tmp." not in fn and _gc_protected(key):
+                continue
+            try:
+                if os.path.isdir(path):
+                    continue
+                if now - os.stat(path).st_mtime <= ttl:
+                    continue
+                os.remove(path)
+                removed += 1
+            except OSError:
+                continue
+        return removed
 
 
 class JaxCoordStore(CoordStore):
-    """The jax.distributed coordination-service KV store (the r06 transport)."""
+    """The jax.distributed coordination-service KV store (the r06 transport).
 
-    def __init__(self):
-        from jax._src import distributed as _jdist
+    ``client`` injects a coordination client directly (tests drive the
+    barrier/claim semantics with an in-memory fake); the default is the
+    live jax.distributed global client. The barrier is the generic
+    KV-poll one from :class:`CoordStore` — unlike the coordination
+    service's native ``wait_at_barrier`` it can name WHICH ids never
+    arrived when a member dies mid-barrier."""
 
-        self._client = _jdist.global_state.client
+    def __init__(self, client=None):
+        if client is None:
+            from jax._src import distributed as _jdist
+
+            client = _jdist.global_state.client
+        self._client = client
         assert self._client is not None, "jax.distributed is not initialized"
 
     def set(self, key: str, value: bytes) -> None:
         self._client.key_value_set_bytes(key, value)
+
+    def set_if_absent(self, key: str, value: bytes) -> bool:
+        # the coordination service's keys are write-once: a plain set IS
+        # the atomic claim, and "already exists" means a racer won
+        try:
+            self._client.key_value_set_bytes(key, value)
+            return True
+        except Exception:  # noqa: BLE001 — key exists
+            return False
 
     def set_mutable(self, key: str, value: bytes) -> None:
         # the coordination service's keys are write-once: emulate overwrite
@@ -207,16 +352,23 @@ class JaxCoordStore(CoordStore):
         except Exception:  # noqa: BLE001
             pass
 
-    def barrier(self, bid: str, timeout_ms: int, ids: list[int], my_id: int) -> None:
-        import jax
-
+    def list(self, prefix: str) -> list[str]:
         try:
-            if len(ids) < jax.process_count():
-                self._client.wait_at_barrier(bid, int(timeout_ms), process_ids=ids)
-            else:
-                self._client.wait_at_barrier(bid, int(timeout_ms))
-        except Exception as e:  # noqa: BLE001
-            raise TimeoutError(f"barrier {bid}: {e}") from e
+            items = self._client.key_value_dir_get_bytes(prefix)
+        except Exception:  # noqa: BLE001
+            return []
+        out = []
+        for item in items:
+            key = (
+                item[0]
+                if isinstance(item, (tuple, list))
+                else getattr(item, "key", None)
+            )
+            if isinstance(key, bytes):
+                key = key.decode("utf-8", "replace")
+            if isinstance(key, str):
+                out.append(key)
+        return sorted(out)
 
 
 def coord_store() -> CoordStore:
@@ -340,6 +492,7 @@ class ExchangeGroup:
         return f"srhb/{self.gid}/{pid}"
 
     def _heartbeat_loop(self):
+        gc = getattr(self.store, "gc", None)
         while not self._hb_stop.is_set():
             try:
                 self.store.set_mutable(
@@ -347,6 +500,14 @@ class ExchangeGroup:
                 )
             except Exception:  # noqa: BLE001 — heartbeats are best-effort
                 pass
+            if gc is not None:
+                try:
+                    # opportunistic TTL sweep (SR_COORD_GC_S; self-throttled
+                    # and a no-op at the default 0) so long-lived groups
+                    # reclaim their own gather/barrier/heartbeat litter
+                    gc()
+                except Exception:  # noqa: BLE001
+                    pass
             self._hb_stop.wait(self._hb_every)
 
     def peers_alive(self) -> dict[int, float]:
@@ -507,8 +668,10 @@ class ExchangeGroup:
             self.store.barrier(
                 self._barrier_id(seq), timeout_ms, order, self.my_id
             )
-        except TimeoutError as e:
+        except (TimeoutError, dist.PeerLossError) as e:
             if self.on_peer_loss == "raise":
+                if isinstance(e, dist.PeerLossError):
+                    raise  # already names the missing ids
                 raise RuntimeError(
                     f"group {self.gid}: barrier failed across {order} ({e})"
                 ) from e

@@ -15,9 +15,14 @@ Three layers:
 - ``journal`` — ``JobJournal``: the opt-in write-ahead log behind
   ``SearchServer(journal_dir=...)`` crash recovery, retries, and the
   QUARANTINED poison-job state.
+- ``pod`` — ``PodNode``/``PodClient``: pod-scale federation — N servers
+  over a shared CoordStore presenting one logical service, with
+  warmth/load-aware admission, lane migration off dead hosts, and
+  SIGTERM graceful drain.
 """
 
 from .journal import JobJournal
+from .pod import PodClient, PodNode
 from .program_cache import (
     ProgramCache,
     enable_persistent_compilation_cache,
@@ -37,6 +42,7 @@ from .queue import (
     JobQueue,
     JobSpec,
     ServerOverloaded,
+    bucket_digest,
     options_digest,
     queue_age_seconds,
     shape_bucket,
@@ -53,8 +59,11 @@ __all__ = [
     "JobJournal",
     "SearchServer",
     "ServerOverloaded",
+    "PodNode",
+    "PodClient",
     "shape_bucket",
     "options_digest",
+    "bucket_digest",
     "queue_age_seconds",
     "QUEUED",
     "RUNNING",

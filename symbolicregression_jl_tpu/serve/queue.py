@@ -40,7 +40,7 @@ import numpy as np
 
 __all__ = [
     "JobSpec", "Job", "JobQueue", "ServerOverloaded", "shape_bucket",
-    "options_digest", "queue_age_seconds",
+    "options_digest", "bucket_digest", "queue_age_seconds",
 ]
 
 
@@ -112,6 +112,18 @@ def shape_bucket(X, y, weights, options) -> tuple:
         weights is not None,
         options_digest(options),
     )
+
+
+def bucket_digest(bucket: tuple) -> str:
+    """12-hex digest of a :func:`shape_bucket` tuple — the warmth currency
+    pod hosts advertise over the CoordStore. The full tuple is big (it
+    embeds the Options digest) and only equality matters cross-process;
+    every element reprs deterministically (shapes, dtype strings, ints,
+    bools, operator/loss *names*), so equal buckets digest equally in any
+    process running the same code."""
+    import hashlib
+
+    return hashlib.sha1(repr(bucket).encode()).hexdigest()[:12]
 
 
 @dataclasses.dataclass

@@ -220,9 +220,10 @@ class SearchServer:
         self._shed = 0
         self._stalls = 0
         self._worker_restarts = 0
+        self._admission_paused = threading.Event()
         self._recovered = {
             "queued": 0, "running": 0, "resumed": 0, "terminal": 0,
-            "dropped": 0,
+            "dropped": 0, "quarantined": 0,
         }
         self.journal = None
         if self.journal_dir:
@@ -281,6 +282,21 @@ class SearchServer:
                 job.error = job.error or "server restarted mid-subscription"
                 self._finalize(job, q.CANCELLED, release=False)
                 self._recovered["terminal"] += 1
+                continue
+            if job.attempts > self.job_retries:
+                # the retry budget is journaled (start/requeue records carry
+                # the attempt counter): a poison job that takes the whole
+                # server down must not re-enter with a fresh budget after
+                # every restart — quarantine it here, exactly where
+                # _retry_or_quarantine would have
+                job.error = job.error or (
+                    f"quarantined on recovery: {job.attempts} attempt(s) "
+                    f"exceed SR_JOB_RETRIES={self.job_retries}"
+                )
+                with self._lock:
+                    self._quarantined += 1
+                self._finalize(job, q.QUARANTINED, release=False)
+                self._recovered["quarantined"] += 1
                 continue
             was_running = st["state"] == "running"
             if self._adopt_checkpoint(job, st.get("ckpt")):
@@ -398,6 +414,119 @@ class SearchServer:
 
     def __exit__(self, *exc) -> None:
         self.shutdown()
+
+    # -- drain (graceful handoff) ---------------------------------------------
+    def pause_admission(self) -> None:
+        """Stop workers from picking up queued jobs; running jobs are
+        unaffected. Reversible with :meth:`resume_admission`."""
+        self._admission_paused.set()
+
+    def resume_admission(self) -> None:
+        self._admission_paused.clear()
+        self._queue.wake_all()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Graceful-handoff drain (the SIGTERM shape): pause admission,
+        ask every RUNNING search to yield at its next iteration boundary
+        (the preemption path: a format-2 spool snapshot + a journaled
+        ``requeue``), and wait until nothing is running. Queued jobs stay
+        queued — with a journal they remain durably adoptable, which is the
+        point: follow with ``shutdown(cancel_queued=False)`` and another
+        host can take the journal over with zero loss. Subscriptions have
+        no resumable budget and are stopped like a client cancel. Returns
+        True when the server went idle within ``timeout``."""
+        self.pause_admission()
+        with self._lock:
+            running = list(self._running.values())
+        for job in running:
+            if job.spec.kind == "search":
+                job.preempt_requested.set()
+            else:
+                job.cancel_requested.set()
+                if job.session is not None:
+                    job.session.request_stop()
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._running:
+                    return True
+            time.sleep(min(0.02, self.poll_seconds))
+        with self._lock:
+            return not self._running
+
+    # -- federated adoption (pod runtime) --------------------------------------
+    def adopt_external(
+        self,
+        spec: JobSpec,
+        *,
+        attempts: int = 0,
+        iterations_done: int = 0,
+        ckpt: str | None = None,
+        submitted_at: float | None = None,
+        error: str | None = None,
+    ) -> str:
+        """Admit a job recovered from ANOTHER server's journal (the pod
+        runtime's lane migration): re-journal it locally under a fresh id,
+        preserve its attempt counter and original submit time (deadlines
+        keep measuring from the tenant's submit, and the retry budget
+        cannot reset by changing hosts — the same invariant `_recover`
+        enforces), and adopt the dead host's checkpoint so an exact
+        lockstep snapshot resumes bit-identically. Returns the local job
+        id; a job already past the retry budget finalizes QUARANTINED
+        without running."""
+        if self._stopping:
+            raise RuntimeError("server is shutting down")
+        if spec.kind != "search":
+            raise ValueError("only search jobs can be adopted")
+        with self._lock:
+            self._seq += 1
+            job_id = f"job-{self._seq:05d}"
+            job = Job(job_id, spec, seq=self._seq)
+            self._jobs[job_id] = job
+        if submitted_at is not None:
+            job.submitted_at = float(submitted_at)
+            job.deadline_at = (
+                None
+                if spec.deadline_seconds is None
+                else job.submitted_at + spec.deadline_seconds
+            )
+        job.attempts = int(attempts)
+        job.iterations_done = int(iterations_done)
+        job.error = error
+        if self.journal is not None:
+            try:
+                self.journal.append_submit(job)
+            except Exception:
+                try:
+                    self.journal.replay()
+                except Exception:
+                    pass
+        if job.attempts > self.job_retries:
+            job.error = error or (
+                f"quarantined on adoption: {job.attempts} attempt(s) "
+                f"exceed SR_JOB_RETRIES={self.job_retries}"
+            )
+            with self._lock:
+                self._quarantined += 1
+            self._finalize(job, q.QUARANTINED, release=False)
+            return job_id
+        if self._adopt_checkpoint(job, ckpt):
+            # the adopted snapshot lives in the DEAD host's spool; requeue
+            # with its path so a crash here still re-adopts it
+            self._jappend(
+                "requeue", job.id, attempts=job.attempts, not_before=0.0,
+                ckpt=job.resume_path,
+            )
+        self._queue.submit(job)
+        self._queue.wake_all()
+        return job_id
+
+    def warm_digests(self) -> list[str]:
+        """Digests of the shape buckets this server has run (and whose
+        compiled programs are therefore resident) — the warmth block of a
+        pod host's load advertisement."""
+        with self._lock:
+            return sorted(q.bucket_digest(b) for b in self._warm_buckets)
 
     # -- client surface -------------------------------------------------------
     def submit(self, spec: JobSpec) -> str:
@@ -544,6 +673,7 @@ class SearchServer:
                 "jobs": by_state,
                 "queued": len(self._queue),
                 "running": len(self._running),
+                "admission_paused": self._admission_paused.is_set(),
                 "warm_buckets": len(self._warm_buckets),
                 "retries": self._retries,
                 "quarantined": self._quarantined,
@@ -586,6 +716,11 @@ class SearchServer:
         from ..utils import faults
 
         while not self._stopping:
+            if self._admission_paused.is_set():
+                # draining: running jobs keep their workers (they are past
+                # this gate); idle workers stop picking the queue up
+                self._stop_event.wait(self.poll_seconds)
+                continue
             now = time.time()
             for job in self._queue.take_expired(now):
                 state = (
@@ -673,12 +808,18 @@ class SearchServer:
         keys off the leader alone (a follower occupies no device lane, so
         evicting the shared run for it would waste everyone's progress)."""
         spec = job.spec
+        # the server owns the engine's iteration_callback slot, so a
+        # tenant-supplied callback is chained here instead of replaced
+        # (dedup riders share the leader's lane; the leader's own callback
+        # is the one that runs)
+        user_cb = spec.options.iteration_callback
 
         def _on_iteration(report) -> bool | None:
             from ..utils import faults
 
             job.heartbeat = time.time()
             job.iterations_done = job.iteration_base + report.iteration
+            user_stop = user_cb(report) if user_cb is not None else None
             hit = faults.active().fire("stall")
             if hit is not None:
                 # a hung run: no heartbeat for delay_s — but poll the
@@ -734,7 +875,7 @@ class SearchServer:
                 or self._stopping
             ):
                 return True
-            return None
+            return True if user_stop else None
 
         return _on_iteration
 

@@ -100,8 +100,161 @@ def test_file_store_barrier(tmp_path):
 
 def test_file_store_barrier_timeout(tmp_path):
     st = _store(tmp_path)
-    with pytest.raises(TimeoutError):
+    with pytest.raises(dist.PeerLossError):
         st.barrier("bar/missing", 100, [0, 1], 0)
+
+
+def test_barrier_dead_member_names_missing_ids(tmp_path):
+    """A member dying mid-barrier must not hang the survivors: both live
+    stores raise PeerLossError naming exactly the absent ids, within the
+    deadline (satellite r16 — pinned for FileCoordStore AND the KV store)."""
+    import time
+
+    st = _store(tmp_path)
+    errors = []
+
+    def survivor(i):
+        t0 = time.monotonic()
+        try:
+            st.barrier("bar/dead", 400, [0, 1, 2, 3], i)
+        except dist.PeerLossError as e:
+            errors.append((i, e, time.monotonic() - t0))
+
+    # members 0 and 1 arrive; 2 and 3 never do
+    _run_members([lambda i=i: survivor(i) for i in (0, 1)])
+    assert len(errors) == 2
+    for _, e, elapsed in errors:
+        assert sorted(e.missing) == [2, 3]
+        assert "barrier" in str(e) and "2" in str(e) and "3" in str(e)
+        assert elapsed < 5.0  # bounded, not a hang
+
+
+class _FakeKVClient:
+    """Write-once dict with blocking gets — the coordination-service KV
+    surface JaxCoordStore drives (no jax.distributed init needed)."""
+
+    def __init__(self):
+        import threading as _t
+
+        self._kv = {}
+        self._cv = _t.Condition()
+
+    def key_value_set_bytes(self, key, value):
+        with self._cv:
+            if key in self._kv:
+                raise RuntimeError(f"key exists: {key}")
+            self._kv[key] = value
+            self._cv.notify_all()
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        with self._cv:
+            while key not in self._kv:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(key)
+                self._cv.wait(left)
+            return self._kv[key]
+
+    def key_value_delete(self, key):
+        with self._cv:
+            self._kv.pop(key, None)
+
+    def key_value_dir_get_bytes(self, prefix):
+        with self._cv:
+            return [(k, v) for k, v in self._kv.items() if k.startswith(prefix)]
+
+
+def test_kv_store_barrier_dead_member_names_missing_ids():
+    st = mem.JaxCoordStore(client=_FakeKVClient())
+    errors = []
+
+    def survivor(i):
+        try:
+            st.barrier("bar/kvdead", 400, [0, 1, 2], i)
+        except dist.PeerLossError as e:
+            errors.append(e)
+
+    _run_members([lambda i=i: survivor(i) for i in (0, 1)])
+    assert len(errors) == 2
+    for e in errors:
+        assert list(e.missing) == [2]
+        assert "barrier" in str(e)
+
+
+def test_kv_store_set_if_absent_and_list():
+    st = mem.JaxCoordStore(client=_FakeKVClient())
+    assert st.set_if_absent("pod/claim/a", b"me") is True
+    assert st.set_if_absent("pod/claim/a", b"you") is False
+    assert st.try_get("pod/claim/a") == b"me"
+    st.set("pod/x/1", b"1")
+    st.set("pod/x/2", b"2")
+    assert st.list("pod/x/") == ["pod/x/1", "pod/x/2"]
+
+
+def test_file_store_set_if_absent_and_list(tmp_path):
+    st = _store(tmp_path)
+    assert st.set_if_absent("lease/h1", b"me") is True
+    assert st.set_if_absent("lease/h1", b"you") is False  # claim held
+    assert st.try_get("lease/h1") == b"me"
+    st.set("inbox/h0/a", b"1")
+    st.set("inbox/h0/b", b"2")
+    st.set("inbox/h1/c", b"3")
+    assert st.list("inbox/h0/") == ["inbox/h0/a", "inbox/h0/b"]
+    st.delete("lease/h1")
+    assert st.set_if_absent("lease/h1", b"again") is True
+
+
+def test_file_store_gc_sweeps_stale_unprotected_keys(tmp_path, monkeypatch):
+    """SR_COORD_GC_S sweep (satellite r16): stale gather/heartbeat litter
+    goes; epoch records, shards, leases, retire markers, and FRESH keys
+    survive; the default (0) disables the sweep entirely."""
+    import os
+    import time
+
+    st = _store(tmp_path)
+    stale = ["srx/t/e0/s1/r0", "srhb/t/0", "srpod/p/ad/h9", "bar/old/0"]
+    protected = [
+        "srep/t/1",
+        "srshard/t/0",
+        "srpod/p/claim/h9/gen-0001",
+        "srpod/p/retire/h9/gen-0001",
+    ]
+    for k in stale + protected:
+        st.set(k, b"v")
+    old = time.time() - 3600
+    for k in stale + protected:
+        os.utime(st._path(k), (old, old))
+    st.set("srhb/t/fresh", b"v")  # recent — must survive any TTL
+
+    monkeypatch.delenv("SR_COORD_GC_S", raising=False)
+    assert st.gc() == 0  # default off: sweep is a no-op
+
+    removed = st.gc(ttl_s=60.0)
+    assert removed == len(stale)
+    for k in stale:
+        assert st.try_get(k) is None
+    for k in protected:
+        assert st.try_get(k) == b"v"
+    assert st.try_get("srhb/t/fresh") == b"v"
+
+
+def test_file_store_gc_env_driven_self_throttles(tmp_path, monkeypatch):
+    import os
+    import time
+
+    st = _store(tmp_path)
+    monkeypatch.setenv("SR_COORD_GC_S", "60")
+    st.set("srhb/t/old", b"v")
+    old = time.time() - 3600
+    os.utime(st._path("srhb/t/old"), (old, old))
+    assert st.gc() == 1  # first env-driven sweep runs
+    st.set("srhb/t/old2", b"v")
+    os.utime(st._path("srhb/t/old2"), (old, old))
+    assert st.gc() == 0  # throttled: within ttl/4 of the last sweep
+    assert st.gc(ttl_s=60.0) == 1  # explicit ttl bypasses the throttle
 
 
 # -- control rows / digest ----------------------------------------------------

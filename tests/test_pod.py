@@ -1,0 +1,288 @@
+"""Pod-scale federated serving (serve/pod.py, r16): warmth/load routing,
+inbox admission, lane migration off dead and draining hosts, journal
+generations, and the write-once done ledger — all in-process over a
+FileCoordStore (the kill-a-host subprocess drill lives in
+scripts/fault_smoke.py pod; this file pins the protocol pieces)."""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.parallel.membership import FileCoordStore
+from symbolicregression_jl_tpu.serve import (
+    DONE,
+    Job,
+    JobJournal,
+    JobSpec,
+    PodClient,
+    PodNode,
+    bucket_digest,
+    shape_bucket,
+)
+
+
+def _problem(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=8,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+        scheduler="lockstep",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _spec(X, y, **kw):
+    kw.setdefault("options", _opts())
+    kw.setdefault("niterations", 2)
+    return JobSpec(X, y, **kw)
+
+
+def _store(tmp_path):
+    return FileCoordStore(str(tmp_path / "coord"))
+
+
+def _node(store, host, **kw):
+    kw.setdefault("hb_seconds", 0.05)
+    kw.setdefault("suspect_seconds", 0.6)
+    kw.setdefault("max_concurrency", 1)
+    kw.setdefault("poll_seconds", 0.02)
+    return PodNode(host, store=store, **kw)
+
+
+def _client(store, **kw):
+    kw.setdefault("suspect_seconds", 0.6)
+    return PodClient(store=store, **kw)
+
+
+def _ad(store, host, *, t=None, gen=1, warm=(), draining=False,
+        queue_depth=0, running=0, pod="pod0"):
+    store.set_mutable(
+        f"srpod/{pod}/ad/{host}",
+        pickle.dumps({
+            "host": host, "t": time.time() if t is None else t, "gen": gen,
+            "queue_depth": queue_depth, "running": running,
+            "warm": list(warm), "draining": draining, "pid": 0,
+        }),
+    )
+
+
+# -- digests / routing (no engine) ---------------------------------------------
+
+
+def test_bucket_digest_stable_and_shape_sensitive():
+    X, y = _problem()
+    b1 = shape_bucket(X, y, None, _opts(seed=1))
+    b2 = shape_bucket(X, y, None, _opts(seed=2))
+    assert bucket_digest(b1) == bucket_digest(b2)  # seed-agnostic warmth
+    X3, y3 = _problem(n=61)
+    assert bucket_digest(shape_bucket(X3, y3, None, _opts())) != bucket_digest(b1)
+    assert len(bucket_digest(b1)) == 12
+
+
+def test_route_prefers_warm_then_least_loaded(tmp_path):
+    st = _store(tmp_path)
+    X, y = _problem()
+    spec = _spec(X, y)
+    digest = bucket_digest(shape_bucket(spec.X, spec.y, None, spec.options))
+    _ad(st, "cold-idle", queue_depth=0)
+    _ad(st, "warm-busy", warm=[digest], queue_depth=3, running=1)
+    c = _client(st)
+    # warmth beats load: the compiled program is worth more than a queue slot
+    assert c.route(spec) == "warm-busy"
+    _ad(st, "warm-idle", warm=[digest], queue_depth=0)
+    assert c.route(spec) == "warm-idle"  # least loaded within the warm pool
+
+
+def test_route_skips_stale_and_draining_hosts(tmp_path):
+    st = _store(tmp_path)
+    X, y = _problem()
+    spec = _spec(X, y)
+    _ad(st, "dead", t=time.time() - 30)
+    _ad(st, "leaving", draining=True)
+    _ad(st, "alive", queue_depth=5)
+    c = _client(st)
+    assert c.route(spec) == "alive"
+    st.delete("srpod/pod0/ad/alive")
+    with pytest.raises(RuntimeError, match="no live hosts"):
+        c.route(spec)
+
+
+def test_client_load_hint_spreads_a_burst(tmp_path):
+    st = _store(tmp_path)
+    X, y = _problem()
+    _ad(st, "a")
+    _ad(st, "b")
+    c = _client(st)
+    targets = []
+    for _ in range(4):  # a burst between ad beats: ads never refresh here
+        t = c.route(_spec(X, y))
+        targets.append(t)
+        # submit() records the send; do the same so the hint accrues
+        c._sent_since.setdefault(t, []).append(time.time())
+    # without send-aware load hints all 4 would pile onto one host
+    assert targets.count("a") == 2 and targets.count("b") == 2
+
+
+# -- end-to-end over live nodes ------------------------------------------------
+
+
+def test_single_node_end_to_end(tmp_path):
+    st = _store(tmp_path)
+    X, y = _problem()
+    with _node(st, "h0") as node:
+        c = _client(st)
+        deadline = time.monotonic() + 10
+        while not c.live_hosts():
+            assert time.monotonic() < deadline, "node never advertised"
+            time.sleep(0.02)
+        pjid = c.submit(_spec(X, y))
+        rec = c.wait(pjid, timeout=600)
+        assert rec["state"] == DONE and rec["host"] == "h0"
+        assert rec["iterations_done"] == 2
+        assert rec["final_frame"] is not None
+        frame = c.latest_frame(pjid)
+        assert frame is not None and frame["n"] >= 1
+        assert node.stats()["duplicate_results"] == 0
+        assert set(c.results()) == {pjid}
+
+
+def test_adopts_dead_host_journal_and_inbox(tmp_path):
+    """The migration path without subprocesses: a fabricated dead host left
+    a journaled queued job AND an unconsumed inbox envelope behind a stale
+    ad. The survivor claims the generation lease, adopts both, runs them,
+    and publishes each result exactly once."""
+    st = _store(tmp_path)
+    X, y = _problem()
+    pod_root = os.path.join(st.root, "_pod")
+
+    # the dead host "hx": a journaled queued pod job...
+    spec_j = _spec(X, y)
+    spec_j.label = "pj-journaled0001"
+    jdir = os.path.join(pod_root, "hx", "gen-0001")
+    jr = JobJournal(jdir)
+    jr.append_submit(Job("job-00001", spec_j, seq=1))
+    jr.close()
+    # ...an envelope it never consumed...
+    c = _client(st)
+    pjid_inbox = c.submit(_spec(X, y, options=_opts(seed=3)), host="hx")
+    # ...and a heartbeat that lapsed long ago
+    _ad(st, "hx", t=time.time() - 30)
+
+    with _node(st, "h0") as node:
+        recs = c.wait_all(["pj-journaled0001", pjid_inbox], timeout=600)
+        for rec in recs.values():
+            assert rec["state"] == DONE and rec["host"] == "h0"
+        stats = node.stats()
+        assert stats["adopted_hosts"] == 1
+        assert stats["adopted_jobs"] == 1  # the journaled one; inbox routes normally
+        assert stats["duplicate_results"] == 0
+    # the generation lease and the pod epoch record are on the store
+    assert st.try_get("srpod/pod0/claim/hx/gen-0001") is not None
+    ep = pickle.loads(st.try_get("srep/pod:pod0/1"))
+    assert ep["event"] == "adopt" and ep["host"] == "hx" and ep["by"] == "h0"
+    assert st.try_get("srpod/pod0/ad/hx") is None  # off the routing table
+
+
+def test_adopted_terminal_job_reports_once_never_reruns(tmp_path):
+    st = _store(tmp_path)
+    X, y = _problem()
+    spec = _spec(X, y)
+    spec.label = "pj-finished00001"
+    jdir = os.path.join(st.root, "_pod", "hx", "gen-0001")
+    jr = JobJournal(jdir)
+    jr.append_submit(Job("job-00001", spec, seq=1))
+    jr.append("terminal", "job-00001", state=DONE, error=None)
+    jr.close()
+    _ad(st, "hx", t=time.time() - 30)
+
+    c = _client(st)
+    with _node(st, "h0") as node:
+        rec = c.wait("pj-finished00001", timeout=60)
+        assert rec["state"] == DONE
+        assert rec["from_journal_of"] == "hx"  # reported from the record,
+        srv = node.stats()["server"]
+        assert srv["jobs"] == {} and srv["queued"] == 0  # never re-admitted
+        assert node.stats()["duplicate_results"] == 0
+
+
+def test_restart_after_adoption_starts_fresh_generation(tmp_path):
+    """A host that reboots after its generation was adopted must not re-run
+    jobs the adopter now owns: the claim lease forces a fresh generation."""
+    st = _store(tmp_path)
+    X, y = _problem()
+    spec = _spec(X, y)
+    spec.label = "pj-migrated00001"
+    jdir = os.path.join(st.root, "_pod", "hx", "gen-0001")
+    jr = JobJournal(jdir)
+    jr.append_submit(Job("job-00001", spec, seq=1))
+    jr.close()
+    _ad(st, "hx", t=time.time() - 30)
+
+    c = _client(st)
+    with _node(st, "h0") as h0:
+        c.wait("pj-migrated00001", timeout=600)
+        with _node(st, "hx") as hx:  # the dead host comes back
+            assert hx.gen == 2  # gen-0001 is claimed: start a new journal
+            assert hx.stats()["tracked_jobs"] == 0  # nothing re-admitted
+            assert hx.server.stats()["queued"] == 0
+        assert h0.stats()["duplicate_results"] == 0
+    assert len(c.results()) == 1
+
+
+def test_drain_hands_off_queued_jobs_to_survivor(tmp_path):
+    """Graceful drain (the SIGTERM path, in-process): the draining host
+    stops admission, journals its unfinished jobs, publishes a retirement
+    marker, and a survivor adopts the generation without waiting out the
+    suspicion window. Zero jobs lost, zero duplicated."""
+    st = _store(tmp_path)
+    X, y = _problem()
+    c = _client(st)
+
+    h1 = _node(st, "h1").start()
+    try:
+        deadline = time.monotonic() + 10
+        while "h1" not in c.live_hosts():
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        pjids = [
+            c.submit(_spec(X, y, options=_opts(seed=s)), host="h1")
+            for s in range(3)
+        ]
+        # wait until h1 actually owns them (journaled), then drain
+        deadline = time.monotonic() + 30
+        while h1.stats()["tracked_jobs"] < 3:
+            assert time.monotonic() < deadline, "inbox never drained"
+            time.sleep(0.02)
+        assert h1.drain(timeout=60) is True
+        assert h1.drain_seconds is not None
+        assert st.try_get("srpod/pod0/retire/h1/gen-0001") is not None
+
+        with _node(st, "h0") as h0:
+            recs = c.wait_all(pjids, timeout=600)
+            done_hosts = {r["host"] for r in recs.values()}
+            assert all(r["state"] == DONE for r in recs.values())
+            # whatever h1 finished pre-drain reported from h1; the rest
+            # migrated — and nothing ran twice
+            assert done_hosts <= {"h0", "h1"}
+            assert any(r["host"] == "h0" for r in recs.values())
+            assert h0.stats()["duplicate_results"] == 0
+        assert set(c.results()) == set(pjids)
+    finally:
+        h1.stop()

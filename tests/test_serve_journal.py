@@ -308,6 +308,55 @@ def test_persistent_failure_quarantines_with_traceback(tmp_path):
         assert st["quarantined"] == 1 and st["retries"] == 1
 
 
+def test_retry_budget_survives_crash_restart(tmp_path):
+    """Attempts are journaled, so a crash-restart can't launder the
+    SR_JOB_RETRIES budget (satellite r16): a job that burned attempt 1
+    before the crash gets exactly its remaining retries after recovery,
+    not a fresh budget."""
+    jdir = str(tmp_path / "journal")
+    X, y = _problem()
+    jr = JobJournal(jdir)
+    jr.append_submit(Job("job-00001", _spec(X, y), seq=1))
+    jr.append("start", "job-00001", attempts=1)  # crashed mid-attempt 1
+    jr.close()
+
+    faults.install("job_exception@0")  # the recovered retry fails too
+    with SearchServer(
+        max_concurrency=1, journal_dir=jdir,
+        job_retries=1, retry_backoff_s=0.02,
+    ) as srv:
+        job = srv.wait("job-00001", timeout=600)
+        assert job.state == QUARANTINED, job.summary()
+        assert job.attempts == 2  # 1 pre-crash + 1 post-recovery, not reset
+
+
+def test_recovery_quarantines_exhausted_job_without_rerun(tmp_path):
+    """A job that already exhausted its budget before the crash (crashed
+    twice around a persistently failing job) must come back QUARANTINED
+    from replay alone — recovery is not a retry-budget reset, and the
+    poison job must not run even once more."""
+    jdir = str(tmp_path / "journal")
+    X, y = _problem()
+    jr = JobJournal(jdir)
+    jr.append_submit(Job("job-00001", _spec(X, y), seq=1))
+    jr.append("start", "job-00001", attempts=1)  # attempt 1...
+    jr.append("requeue", "job-00001", attempts=1, error="boom")  # ...failed
+    jr.append("start", "job-00001", attempts=2)  # crashed mid-attempt 2
+    jr.close()
+
+    with SearchServer(
+        max_concurrency=1, journal_dir=jdir, job_retries=1,
+    ) as srv:
+        assert srv.stats()["journal"]["recovered"]["quarantined"] == 1
+        job = srv.job("job-00001")
+        assert job.state == QUARANTINED
+        assert job.attempts == 2
+        assert job.result is None  # never reran
+        assert job.error == "boom"  # the journaled cause survives replay
+        time.sleep(0.3)
+        assert srv.stats()["queued"] == 0 and srv.stats()["running"] == 0
+
+
 def test_queue_depth_backpressure_sheds(tmp_path):
     X, y = _problem()
     with SearchServer(
