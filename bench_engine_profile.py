@@ -29,6 +29,16 @@ reports a ``fused_iter`` stage decomposed by probe fractions into
 r07-era compat engine (``SR_FUSED_ITER=0 SR_COPT_COMPAT=1``: split dispatch
 chain + legacy const-opt) and reports the end-to-end iteration_mean speedup.
 
+Round 17 extends ``--ab`` with the kernel-resident evolve block
+(``SR_ENGINE_BLOCK``): the profiled run is repeated with the block pinned
+OFF (``0``) and ON (``1``) and the artifact reports the ``fused_iter``
+speedup plus the ``fused_iter/evolve`` vs ``fused_iter/evolve_block``
+sub-timings (with the mutate/check/score/accept probe decomposition). The
+leg is labeled with the backend that actually ran — ``kernel`` (the Pallas
+grid; TPU or interpret mode) or ``reference`` (the vmapped XLA fallback
+that ``SR_ENGINE_BLOCK=1`` forces on CPU) — and CPU numbers are marked
+indicative-only.
+
 Usage::
 
     JAX_PLATFORMS=cpu python bench_engine_profile.py --niterations 4
@@ -157,7 +167,9 @@ def main():
                     help="CI smoke: tiny problem + config, 2 iterations")
     ap.add_argument("--ab", action="store_true",
                     help="repeat the profiled run under SR_COPT_COMPAT=1 "
-                         "(legacy const-opt) and emit the stage comparison")
+                         "(legacy const-opt) and under SR_ENGINE_BLOCK=0/1 "
+                         "(kernel-resident evolve block) and emit the stage "
+                         "comparisons")
     ap.add_argument("--out", default=None, help="write the artifact JSON here")
     args = ap.parse_args()
 
@@ -217,6 +229,92 @@ def main():
             "iteration_speedup_fused_over_compat": round(
                 it_base / max(it_new, 1e-9), 4
             ),
+        }
+
+    # 1c) evolve-block A/B (r17): the identical profiled run with the
+    # kernel-resident evolve block pinned OFF then ON. The default profiled
+    # run above resolves SR_ENGINE_BLOCK automatically (kernel backend where
+    # Pallas runs, off otherwise), so both legs pin the gate explicitly.
+    engine_block_ab = None
+    if args.ab or args.tiny:
+        from symbolicregression_jl_tpu.ops.interp_pallas import (
+            evolve_block_supported,
+        )
+
+        def _block_leg(res_b):
+            prof_b = res_b.engine_profile
+            st = prof_b["stages"]
+            return {
+                "iteration_mean_ms": prof_b.get("iteration_mean_ms", 0.0),
+                "fused_iter_mean_ms": st.get("fused_iter", {}).get("mean_ms", 0.0),
+                "sub_stages_ms": {
+                    k.split("/", 1)[1]: v.get("mean_ms", 0.0)
+                    for k, v in st.items() if k.startswith("fused_iter/")
+                },
+                "best_loss": float(min(m.loss for m in res_b.pareto_frontier)),
+            }
+
+        # auto-resolution is OFF on plain CPU, so the default profiled run
+        # already IS the off leg there; only rerun it where auto could
+        # have picked the kernel backend
+        auto_is_off = (
+            platform != "tpu"
+            and os.environ.get("SR_PALLAS_INTERPRET", "0") != "1"
+        )
+        if auto_is_off:
+            leg_off = _block_leg(res_p)
+        else:
+            os.environ["SR_ENGINE_BLOCK"] = "0"
+            try:
+                res_b0, _ = _run_search(X, y, kwargs, n_prof, profile=True)
+            finally:
+                del os.environ["SR_ENGINE_BLOCK"]
+            leg_off = _block_leg(res_b0)
+        os.environ["SR_ENGINE_BLOCK"] = "1"
+        try:
+            res_b1, _ = _run_search(X, y, kwargs, n_prof, profile=True)
+        finally:
+            del os.environ["SR_ENGINE_BLOCK"]
+        leg_on = _block_leg(res_b1)
+        backend = (
+            "kernel"
+            if evolve_block_supported(
+                options.operators, X.shape[0], options.loss
+            )
+            else "reference"
+        )
+        evolve_off = leg_off["sub_stages_ms"].get("evolve", 0.0)
+        evolve_on = leg_on["sub_stages_ms"].get("evolve_block", 0.0)
+        engine_block_ab = {
+            "gates": {
+                "off": {"SR_ENGINE_BLOCK": "0"},
+                "on": {"SR_ENGINE_BLOCK": "1"},
+            },
+            "block_backend_on_leg": backend,
+            # reference-backend (CPU) legs bound structure, not TPU speed;
+            # the 2x / VPU targets are claims about the kernel backend
+            "indicative_only": platform != "tpu" or backend != "kernel",
+            "off": leg_off,
+            "on": leg_on,
+            "fused_iter_speedup_block_on_over_off": round(
+                leg_off["fused_iter_mean_ms"]
+                / max(leg_on["fused_iter_mean_ms"], 1e-9), 4
+            ),
+            "iteration_speedup_block_on_over_off": round(
+                leg_off["iteration_mean_ms"]
+                / max(leg_on["iteration_mean_ms"], 1e-9), 4
+            ),
+            "evolve_leg_mean_ms": {
+                "off_evolve": evolve_off, "on_evolve_block": evolve_on,
+            },
+            "evolve_fraction_of_fused_iter": {
+                "off": round(
+                    evolve_off / max(leg_off["fused_iter_mean_ms"], 1e-9), 4
+                ),
+                "on": round(
+                    evolve_on / max(leg_on["fused_iter_mean_ms"], 1e-9), 4
+                ),
+            },
         }
 
     # 2) scoring share inside the fused evolve program
@@ -281,6 +379,8 @@ def main():
                 ),
             }
         out["const_opt_ab"] = const_opt_ab
+    if engine_block_ab is not None:
+        out["engine_block_ab"] = engine_block_ab
     text = json.dumps(out, indent=2)
     print(text)
     if args.out:

@@ -26,6 +26,17 @@ P, R, N = 10_240, 10_240, 20
 V5E_VPU_FLOPS = 3.8e12
 
 
+def _platform_stamp() -> dict:
+    """Machine-readable honesty stamp on every roofline row: which backend
+    actually ran, and an explicit indicative_only flag off-TPU (utilization
+    is reported against the v5e VPU peak either way, so CPU/interpret rows
+    are structural smoke numbers, not roofline measurements)."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    return {"platform": platform, "indicative_only": platform != "tpu"}
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -101,6 +112,7 @@ def main():
         json.dumps(
             {
                 "metric": "kernel_roofline",
+                **_platform_stamp(),
                 "kernel_true_evals_per_sec": round(evals_per_sec, 0),
                 "kernel_exec_ms_per_sweep": round(slope * 1000, 2),
                 "dispatch_overhead_ms": round(intercept * 1000, 1),
@@ -204,6 +216,7 @@ def rows_sweep(P_sweep: int = 512):
         useful_flops = evals_per_sec * slots * R_s
         row = {
             "metric": "kernel_rate_vs_rows",
+            **_platform_stamp(),
             "n_rows": R_s,
             "n_trees": P_sweep,
             "row_tiles_per_tree": C // C_TILE,
@@ -274,7 +287,7 @@ def engine_mode(niterations: int = 4, R_e: int = 10_240):
         json.dumps(
             {
                 "metric": "engine_utilization",
-                "platform": platform,
+                **_platform_stamp(),
                 "n_rows": R_e,
                 "niterations": niterations,
                 "populations": opts.populations,
@@ -282,6 +295,7 @@ def engine_mode(niterations: int = 4, R_e: int = 10_240):
                 "ncycles_per_iteration": opts.ncycles_per_iteration,
                 "SR_FUSED_ITER": os.environ.get("SR_FUSED_ITER", "1"),
                 "SR_ENGINE_PALLAS": os.environ.get("SR_ENGINE_PALLAS", "1"),
+                "SR_ENGINE_BLOCK": os.environ.get("SR_ENGINE_BLOCK", "auto"),
                 "num_evals": float(res.num_evals),
                 "loop_s": round(res.iteration_seconds, 3),
                 "tree_evals_per_sec": round(

@@ -14,6 +14,10 @@ is outside the operator basis by construction).
 
 Scheduler: the device-resident engine on TPU, lockstep on CPU.
 Emits one JSON line per config plus a summary line.
+
+``--block-ab [--out FILE]`` (r17) runs the SR_ENGINE_BLOCK solved-count A/B
+instead: a seed sweep of a scaled config-1 with the kernel-resident evolve
+block pinned off/on, reporting per-leg recovery counts (see ``block_ab``).
 """
 
 import json
@@ -139,8 +143,112 @@ def config_complex(niterations: int = 6):
     }
 
 
-def main():
+def block_ab(seeds=(0, 1, 2, 3, 4, 5), niterations: int = 10):
+    """SR_ENGINE_BLOCK solved-count A/B (r17): the kernel-resident evolve
+    block diverges from the XLA evolve loop by construction (tournament with
+    replacement, folded crossover — see ops/evolve_block.py), so the gate is
+    OUTCOME parity, not bit parity: over a seed sweep of the config-1
+    recovery problem, the block leg must not lose solves vs the baseline.
+
+    Runs a device-scheduler config-1 scaled to CPU walls (8x32 islands,
+    100 cycles/iteration) with SR_ENGINE_BLOCK pinned 0 then 1 per seed and
+    reports per-seed recovery plus the solved counts. On CPU the =1 leg runs
+    the vmapped XLA reference backend — same cycle math as the kernel
+    (pinned bit-exact by tests/test_pallas_interpret.py), so the outcome
+    comparison transfers."""
+    import os
+
     import jax
+
+    from bench_problems import config1_problem
+    from symbolicregression_jl_tpu import Options, equation_search
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        evolve_block_supported,
+    )
+
+    X, y, Xh, yh, kwargs = config1_problem(holdout_rows=500)
+    kwargs = dict(
+        kwargs, populations=8, population_size=32, ncycles_per_iteration=100
+    )
+    rows = []
+    for seed in seeds:
+        for mode in ("0", "1"):
+            options = Options(
+                save_to_file=False, seed=seed, scheduler="device", **kwargs
+            )
+            os.environ["SR_ENGINE_BLOCK"] = mode
+            t0 = time.time()
+            try:
+                res = equation_search(
+                    X, y, options=options, niterations=niterations, verbosity=0
+                )
+            finally:
+                del os.environ["SR_ENGINE_BLOCK"]
+            wall = time.time() - t0
+            best = min(res.pareto_frontier, key=lambda m: m.loss)
+            pred = best.tree.eval_np(Xh.astype(np.float64), options.operators)
+            resid = float(np.mean((pred - yh) ** 2))
+            rows.append(
+                {
+                    "seed": seed,
+                    "SR_ENGINE_BLOCK": mode,
+                    "recovered": bool(resid < 1e-2),
+                    "holdout_mse": round(resid, 8),
+                    "train_loss": round(float(best.loss), 8),
+                    "wall_s": round(wall, 1),
+                    "best_equation": best.tree.string_tree(options.operators),
+                }
+            )
+    solved = {
+        mode: sum(
+            1 for r in rows if r["SR_ENGINE_BLOCK"] == mode and r["recovered"]
+        )
+        for mode in ("0", "1")
+    }
+    backend = (
+        "kernel"
+        if evolve_block_supported(options.operators, X.shape[0], options.loss)
+        else "reference"
+    )
+    return {
+        "artifact": "BENCH_QUALITY_BLOCK",
+        "platform": jax.devices()[0].platform,
+        "block_backend_on_leg": backend,
+        "config": {
+            "name": "config1_scaled_8x32",
+            "rows": int(X.shape[1]),
+            "niterations": niterations,
+            "seeds": list(seeds),
+            **{k: v for k, v in kwargs.items() if not callable(v)},
+        },
+        "solved_of_n": {
+            "SR_ENGINE_BLOCK=0": f"{solved['0']}/{len(seeds)}",
+            "SR_ENGINE_BLOCK=1": f"{solved['1']}/{len(seeds)}",
+        },
+        "solved_count_delta_on_minus_off": solved["1"] - solved["0"],
+        "per_seed": rows,
+        "note": (
+            "solved bar = holdout_mse < 1e-2 (config-1 recovery); the block "
+            "mutation pipeline is divergence-by-design, so parity is judged "
+            "on solves, not trajectories"
+        ),
+    }
+
+
+def main():
+    import sys
+
+    import jax
+
+    if "--block-ab" in sys.argv:
+        out = block_ab()
+        text = json.dumps(out, indent=2)
+        print(text)
+        for i, a in enumerate(sys.argv):
+            if a == "--out" and i + 1 < len(sys.argv):
+                with open(sys.argv[i + 1], "w") as f:
+                    f.write(text + "\n")
+        return
 
     on_tpu = jax.devices()[0].platform != "cpu"
     scheduler = "device" if on_tpu else "lockstep"

@@ -35,13 +35,21 @@ import numpy as np
 
 from ..ops.flat import (
     KIND_BINARY,
+    KIND_CONST,
     KIND_PAD,
     KIND_UNARY,
     KIND_VAR,
+    PACK_KIND_BITS,
+    PACK_KIND_MASK,
     bucket_sizes,
 )
 
-__all__ = ["FlatIRError", "verify_flat_trees", "debug_checks_enabled"]
+__all__ = [
+    "FlatIRError",
+    "verify_flat_trees",
+    "verify_packed_programs",
+    "debug_checks_enabled",
+]
 
 
 class FlatIRError(ValueError):
@@ -216,3 +224,157 @@ def verify_flat_trees(
             "feat_range",
             f"{where}tree {p} slot {s}: feat={int(feat[p, s])} not {bound}",
         )
+
+
+def verify_packed_programs(
+    packed,
+    opset=None,
+    *,
+    n_features: int | None = None,
+    allow_empty: bool = True,
+    where: str = "",
+) -> None:
+    """Validate a :class:`~..ops.flat.PackedPrograms` batch.
+
+    The packed IR has no stored child pointers, so postorder soundness is a
+    *stack discipline* over the word stream: walking slots ``0..length-1``,
+    leaves push one operand, unary ops are depth-neutral, binary ops pop one
+    — the running depth must stay ``>= 1`` after every live slot and end at
+    exactly 1 (named **stack**). The remaining checks mirror
+    ``verify_flat_trees``: **dtype** (words are int16), **kind_range**,
+    **op_range** / **feat_range** on the payload bits, **pad_kind** /
+    **pad_zero** (pad words AND consts are exactly zero, consts are zero on
+    every non-CONST slot), and **length_range**. Vectorized numpy throughout
+    (the stack pass is a cumulative sum, not a loop). Raises
+    :class:`FlatIRError` on the first violation.
+    """
+    words = np.asarray(packed.words)
+    consts = np.asarray(packed.consts)
+    length = np.asarray(packed.length)
+
+    if words.dtype != np.int16:
+        raise FlatIRError(
+            "dtype", f"{where}words dtype {words.dtype} != int16"
+        )
+    if words.ndim != 2:
+        raise FlatIRError(
+            "shape", f"{where}words must be [P, N], got {words.shape}"
+        )
+    P, N = words.shape
+    if consts.shape != (P, N):
+        raise FlatIRError(
+            "shape", f"{where}consts shape {consts.shape} != {(P, N)}"
+        )
+    if length.shape != (P,):
+        raise FlatIRError(
+            "shape", f"{where}length shape {length.shape} != ({P},)"
+        )
+
+    lo = 0 if allow_empty else 1
+    if P and (length.min() < lo or length.max() > N):
+        p = int(np.argmax((length < lo) | (length > N)))
+        raise FlatIRError(
+            "length_range",
+            f"{where}row {p}: length={int(length[p])} outside [{lo}, {N}]",
+        )
+
+    w32 = words.astype(np.int32)
+    kind = w32 & PACK_KIND_MASK
+    payload = w32 >> PACK_KIND_BITS
+
+    if (kind > KIND_BINARY).any() or (w32 < 0).any():
+        p, s = _first_bad((kind > KIND_BINARY) | (w32 < 0))
+        raise FlatIRError(
+            "kind_range",
+            f"{where}row {p} slot {s}: word={int(w32[p, s])} has kind "
+            f"{int(kind[p, s])} outside [{KIND_PAD}, {KIND_BINARY}]",
+        )
+
+    cols = np.arange(N, dtype=length.dtype)[None, :]
+    live = cols < length[:, None]
+
+    mism = (kind != KIND_PAD) != live
+    if mism.any():
+        p, s = _first_bad(mism)
+        what = "PAD kind in live range" if live[p, s] else "non-PAD word in padding"
+        raise FlatIRError(
+            "pad_kind",
+            f"{where}row {p} slot {s}: {what} (word={int(w32[p, s])})",
+        )
+
+    # payload must be zero wherever it has no meaning (CONST slots and
+    # padding carry no payload), and consts exactly zero off CONST slots —
+    # canonical zeros are what make packed A/B comparisons bitwise.
+    bad = (kind <= KIND_CONST) & (payload != 0)
+    if bad.any():
+        p, s = _first_bad(bad)
+        raise FlatIRError(
+            "pad_zero",
+            f"{where}row {p} slot {s}: payload={int(payload[p, s])} nonzero "
+            f"on kind={int(kind[p, s])}",
+        )
+    bad = (kind != KIND_CONST) & (consts != 0)
+    if bad.any():
+        p, s = _first_bad(bad)
+        raise FlatIRError(
+            "pad_zero",
+            f"{where}row {p} slot {s}: consts={consts[p, s]} nonzero on "
+            f"non-CONST slot",
+        )
+
+    # stack discipline: +1 leaf, 0 unary, -1 binary; running depth >= 1 at
+    # every live slot, == 1 at the root. This is the pointerless postorder
+    # invariant — a cumsum over the delta stream checks every row at once.
+    delta = np.where(
+        live & (kind <= KIND_VAR) & (kind >= KIND_CONST),
+        1,
+        np.where(live & (kind == KIND_BINARY), -1, 0),
+    )
+    depth = np.cumsum(delta, axis=1)
+    bad = live & (depth < 1)
+    if bad.any():
+        p, s = _first_bad(bad)
+        raise FlatIRError(
+            "stack",
+            f"{where}row {p} slot {s}: operand stack underflows "
+            f"(depth={int(depth[p, s])})",
+        )
+    if P:
+        final = np.where(
+            length > 0, depth[np.arange(P), np.maximum(length - 1, 0)], 1
+        )
+        if (final != 1).any():
+            p = int(np.argmax(final != 1))
+            raise FlatIRError(
+                "stack",
+                f"{where}row {p}: {int(final[p])} operands left after the "
+                f"postfix pass (want 1)",
+            )
+
+    if opset is not None:
+        bad = live & (kind == KIND_BINARY) & (payload >= opset.n_binary)
+        if bad.any():
+            p, s = _first_bad(bad)
+            raise FlatIRError(
+                "op_range",
+                f"{where}row {p} slot {s}: binary op={int(payload[p, s])} "
+                f"outside [0, {opset.n_binary})",
+            )
+        bad = live & (kind == KIND_UNARY) & (payload >= opset.n_unary)
+        if bad.any():
+            p, s = _first_bad(bad)
+            raise FlatIRError(
+                "op_range",
+                f"{where}row {p} slot {s}: unary op={int(payload[p, s])} "
+                f"outside [0, {opset.n_unary})",
+            )
+
+    if n_features is not None:
+        bad = live & (kind == KIND_VAR) & (payload >= n_features)
+        if bad.any():
+            p, s = _first_bad(bad)
+            raise FlatIRError(
+                "feat_range",
+                f"{where}row {p} slot {s}: feat={int(payload[p, s])} outside "
+                f"[0, {n_features})",
+            )

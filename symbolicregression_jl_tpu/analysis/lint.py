@@ -31,6 +31,11 @@ SRL009      direct mutation of a module-level program-cache dict outside the
             cache API (the pre-r12 ``_SCORE_FN_CACHE``/``_AOT_CACHE`` class:
             ad-hoc dicts fork eviction/locking policy from the unified
             ``serve.program_cache.ProgramCache``)
+SRL010      host-side program-IR packing (``pack_flat`` /
+            ``pack_flat_fused``) inside an engine hot loop — the per-cycle
+            HBM round-trip the r17 kernel-resident evolve block removes;
+            programs must stay device-resident across cycles (pack once
+            outside the loop, or in-graph via ``ops.flat.pack_words``)
 ==========  ==================================================================
 
 Suppressions: a trailing ``# srl: disable=SRL001[,SRL002] [-- reason]``
@@ -106,6 +111,15 @@ RULES = {
         "_SCORE_FN_CACHE/_AOT_CACHE class, including an unlocked cross-"
         "thread .get race); route compiled-program caching through "
         "serve.program_cache (global_program_cache().get/put)",
+    ),
+    "SRL010": (
+        "host-ir-pack-in-hot-loop",
+        "host-side program-IR packing (pack_flat / pack_flat_fused) inside "
+        "an engine hot loop — every call round-trips candidate programs "
+        "through host memory and HBM, the exact per-cycle cost the r17 "
+        "kernel-resident evolve block exists to remove; pack once outside "
+        "the loop or keep programs device-resident (ops.flat.pack_words "
+        "in-graph)",
     ),
 }
 
@@ -536,6 +550,42 @@ def _check_pallas_hot_packing(tree, path, findings):
                 ))
 
 
+#: host program-IR packers the SRL010 contract bans from hot loops (r17:
+#: the evolve block keeps programs device-resident for a whole cycle block)
+IR_PACK_FUNCS = {"pack_flat", "pack_flat_fused"}
+
+
+def _check_ir_pack_hot_loop(tree, path, findings):
+    """SRL010: host program-IR packing inside loops of engine-driver
+    functions. ``pack_flat``/``pack_flat_fused`` pull the candidate batch to
+    the host and re-upload it — per-cycle, that is the HBM round-trip the
+    kernel-resident evolve block removes. Same loop/hot-function scoping as
+    SRL008."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, _FUNC_DEFS) or fn.name not in HOT_PATH_FUNCTIONS:
+            continue
+        loops = [
+            n
+            for n in ast.walk(fn)
+            if isinstance(n, ast.For | ast.While) and _enclosing_function(n) is fn
+        ]
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not any(_inside(node, lp) for lp in loops):
+                continue
+            name = _tail(_dotted(node.func))
+            if name in IR_PACK_FUNCS:
+                findings.append(Finding(
+                    "SRL010", path, node.lineno, node.col_offset,
+                    f"host IR packing {name}(...) inside the `{fn.name}` "
+                    "engine loop — round-trips candidate programs through "
+                    "the host every cycle; pack once outside the loop or "
+                    "keep programs device-resident (pack_words in-graph / "
+                    "SR_ENGINE_BLOCK)",
+                ))
+
+
 def _split_key_arg(node: ast.Call) -> str | None:
     """`jax.random.split(key[, n])` -> 'key' when arg0 is a plain Name."""
     if _tail(_dotted(node.func)) != "split":
@@ -921,6 +971,7 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     _check_traced_rules(tree, path, findings)
     _check_hot_sync(tree, path, findings)
     _check_pallas_hot_packing(tree, path, findings)
+    _check_ir_pack_hot_loop(tree, path, findings)
     _check_key_reuse(tree, path, findings)
     _check_donated_reuse(tree, path, findings)
     _check_cache_keys(tree, path, findings)

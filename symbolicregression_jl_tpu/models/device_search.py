@@ -333,6 +333,7 @@ def _make_score_fn(
     X, y, weights, options: Options, use_pallas: bool, ds_key=None,
     norm: float = 1.0, need_raw: bool = True,
     rows_axis: str | None = None, rows_shards: int = 1, mesh=None,
+    need_packed: bool = False,
 ):
     """Build the in-graph scoring closure + its dataset pytree.
 
@@ -389,6 +390,7 @@ def _make_score_fn(
         ds_key if ds_key is not None else _dataset_key(X, y, weights),
         use_pallas,
         need_raw,
+        need_packed,
         float(norm),  # baseline depends on the LOSS, not just the data bytes
         rows_shards,
     )
@@ -400,7 +402,8 @@ def _make_score_fn(
             )
         else:
             data = _make_score_data(
-                X, y, weights, use_pallas, norm=norm, need_raw=need_raw
+                X, y, weights, use_pallas, norm=norm, need_raw=need_raw,
+                need_packed=need_packed,
             )
         # charged by DEVICE BYTES, not entry count: retention stays
         # proportional to the memory actually held (SR_SCORE_DATA_CACHE_MB)
@@ -426,19 +429,22 @@ class ScoreData(NamedTuple):
 
 
 def _make_score_data(
-    X, y, weights, use_pallas: bool, norm: float = 1.0, need_raw: bool = True
+    X, y, weights, use_pallas: bool, norm: float = 1.0, need_raw: bool = True,
+    need_packed: bool = False,
 ) -> ScoreData:
     """need_raw: upload the unpacked Xd/yd/wd copies only when a consumer
     exists (minibatch gather, scan-interpreter scoring, or the non-Pallas
     const-opt fallback); on the pure-Pallas path they would double the
-    HBM retention per cached dataset for nothing."""
+    HBM retention per cached dataset for nothing. need_packed: force the
+    sublane row pack even when use_pallas is off — the evolve-block's XLA
+    reference backend (SR_ENGINE_BLOCK=1 on CPU) scores against Xr/yr/wr."""
     import jax.numpy as jnp
 
     from ..ops.interp_pallas import _reshape_rows
 
     has_w = weights is not None
     kw = {}
-    if use_pallas:
+    if use_pallas or need_packed:
         Xr, yr, wr, _, _ = _reshape_rows(X, y, weights)
         kw.update(Xr=Xr, yr=yr, wr=wr)
     if need_raw or not use_pallas:
@@ -1380,8 +1386,61 @@ def _count_dispatch(name: str):
         hook(name)
 
 
+def _blk_row_limit() -> int:
+    """Rows the evolve-block holds resident per score pass (one packed row
+    tile's sublane count is applied by the caller: R <= 8 * this)."""
+    from ..ops.interp_pallas import C_TILE
+
+    return C_TILE
+
+
+def _make_block_fn(opset, loss_elem, ecfg, n_rows: int, backend: str,
+                   stages: int = 4):
+    """Identity-stable ``(state, data) -> state`` closure over the r17
+    kernel-resident evolution block (ops/evolve_block.run_block_iteration).
+    Memoized in PROGRAM_CACHE: the closure travels as a jit STATIC argument
+    of the fused megaprogram, so a fresh lambda per search would defeat both
+    the jit cache and the AOT ``k_fused`` executable key. ``backend``:
+    "kernel" scores through the Pallas evolve-block grid, "reference"
+    through the vmapped XLA twin (same _block_cycle trajectory)."""
+    key = (
+        "block_fn", opset, loss_elem, ecfg, n_rows, backend, stages,
+        _pallas_interpret(),
+    )
+    fn = PROGRAM_CACHE.get("block_fn", key)
+    if fn is None:
+        from ..ops.evolve_block import (
+            make_reference_eval,
+            run_block_iteration,
+        )
+
+        if backend == "kernel":
+            from ..ops.interp_pallas import make_evolve_block_fn
+
+            def fn(state, data):
+                kfn = make_evolve_block_fn(
+                    data.Xr, data.yr, data.wr, n_rows, opset, loss_elem,
+                    ecfg, stages=stages,
+                )
+                return run_block_iteration(
+                    state, data, ecfg, kernel_fn=kfn, stages=stages
+                )
+        else:
+
+            def fn(state, data):
+                eval_fn = make_reference_eval(
+                    opset, loss_elem, data.Xr, data.yr, data.wr, n_rows
+                )
+                return run_block_iteration(
+                    state, data, ecfg, eval_fn=eval_fn, stages=stages
+                )
+        fn = PROGRAM_CACHE.put("block_fn", key, fn)
+    return fn
+
+
 def _probe_fused_fractions(
-    state, score_data, ecfg, score_fn, copt_impl, fin_score_fn, repeats=3
+    state, score_data, ecfg, score_fn, copt_impl, fin_score_fn, repeats=3,
+    block_stage_fns=None,
 ):
     """Estimate the fused megaprogram's per-leg decomposition by timing each
     leg as its own (non-donated) program against the live pre-loop state.
@@ -1389,14 +1448,24 @@ def _probe_fused_fractions(
     the split programs once, purely to keep ENGINE_PROFILE artifacts
     comparable — the reported ``fused_iter/<leg>`` sub-timings are this
     probe's fractions applied to each iteration's fused wall, not in-program
-    measurements (XLA exposes none inside one executable)."""
+    measurements (XLA exposes none inside one executable).
+
+    ``block_stage_fns``: SR_ENGINE_BLOCK probe — a 4-tuple of staged block
+    closures (stages 1..4 cumulative: mutate, +check, +score, +accept). The
+    evolve leg is replaced by the full block (``evolve_block``) and the
+    stage walls decompose it into ``evolve_block/{mutate,check,score,
+    accept}`` sub-fractions (each stage's marginal cost over the previous)."""
     import jax
 
     from ..ops.evolve import run_finalize, run_iteration
 
-    legs = [
-        ("evolve", lambda st: run_iteration(st, score_data, ecfg, score_fn))
-    ]
+    if block_stage_fns is not None:
+        blk_full = jax.jit(block_stage_fns[-1])
+        legs = [("evolve_block", lambda st: blk_full(st, score_data))]
+    else:
+        legs = [
+            ("evolve", lambda st: run_iteration(st, score_data, ecfg, score_fn))
+        ]
     if copt_impl is not None:
         copt_jit = jax.jit(copt_impl)
         legs.append(("const_opt", lambda st: copt_jit(st, score_data)))
@@ -1419,7 +1488,32 @@ def _probe_fused_fractions(
     total = sum(times.values())
     if total <= 0.0:
         return None
-    return {k: v / total for k, v in times.items()}
+    fracs = {k: v / total for k, v in times.items()}
+    if block_stage_fns is not None:
+        # inside-the-block decomposition: stage s runs stages 1..s of the
+        # cycle body (earlier stages DCE-guarded), so each marginal wall is
+        # that stage's cost. Reported as sub-fractions of the block leg.
+        walls = []
+        for sfn in block_stage_fns:
+            f = jax.jit(sfn)
+            jax.block_until_ready(f(state, score_data))
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                jax.block_until_ready(f(state, score_data))
+            walls.append((time.perf_counter() - t0) / repeats)
+        # marginals normalized by their OWN sum (not the stage-4 wall): at
+        # small scale timing noise can leave a later cumulative wall below
+        # an earlier one, and dividing clamped marginals by walls[-1] would
+        # let one sub-row exceed the whole block leg
+        margs, prev = [], 0.0
+        for wall in walls:
+            margs.append(max(wall - prev, 0.0))
+            prev = wall
+        blk_frac, msum = fracs.get("evolve_block", 0.0), sum(margs)
+        if msum > 0.0:
+            for nm, m in zip(("mutate", "check", "score", "accept"), margs):
+                fracs[f"evolve_block/{nm}"] = blk_frac * m / msum
+    return fracs
 
 
 def _shard_const_opt(mesh, impl, data_specs=None):
@@ -1913,10 +2007,42 @@ def device_search_one_output(
         or not use_pallas
         or (options.should_optimize_constants and not use_pallas_grad)
     )
+    # --- kernel-resident evolution block (SR_ENGINE_BLOCK, r17) -------------
+    # "0" = off; "1" = force (Pallas kernel where it compiles, XLA reference
+    # backend otherwise — the CPU bench/CI path); default = auto, on exactly
+    # where the kernel compiles. The block replaces the evolve leg INSIDE the
+    # fused megaprogram, so every fused-iteration gate (mesh/recorder/replay)
+    # applies too; block_eligible() rejects the config features the block
+    # doesn't implement (batching, constraints, units, event recording, ...).
+    blk_env = os.environ.get("SR_ENGINE_BLOCK", "")
+    block_backend = None
+    if (
+        blk_env != "0"
+        and os.environ.get("SR_FUSED_ITER", "1") != "0"
+        and mesh is None
+        and not options.use_recorder
+        and not ecfg.record_events
+        and options.loss_function_jit is None
+        and eng_dt == np.float32
+        # the whole row set must fit one resident tile: the block scores
+        # every cycle against the same VMEM-held pack, no tile loop
+        and dataset.n <= 8 * _blk_row_limit()
+    ):
+        from ..ops.evolve_block import block_eligible
+
+        if block_eligible(ecfg)[0]:
+            from ..ops.interp_pallas import evolve_block_supported
+
+            if evolve_block_supported(
+                options.operators, dataset.n_features, options.loss
+            ):
+                block_backend = "kernel"
+            elif blk_env == "1":
+                block_backend = "reference"
     score_fn, score_data = _make_score_fn(
         X, y, w, options, use_pallas, ds_key=ds_key, norm=norm_val,
         need_raw=need_raw, rows_axis=rows_axis, rows_shards=rows_shards,
-        mesh=mesh,
+        mesh=mesh, need_packed=block_backend is not None,
     )
     data_specs = score_data_specs(score_data) if rows_axis else None
     bs_local = None
@@ -1981,6 +2107,12 @@ def device_search_one_output(
             copt_impl = make_copt(ecfg, jit=False)
         if cfg.batching:
             fin_sfn = score_fn
+    block_fn = None
+    if fused_iter and block_backend is not None:
+        block_fn = _make_block_fn(
+            options.operators, options.loss, ecfg, int(dataset.n),
+            block_backend,
+        )
 
     # --- initial populations (host trees -> device state) -------------------
     if saved_state is not None:
@@ -2149,6 +2281,10 @@ def device_search_one_output(
         k_fused = (
             "fused", cfg_local, score_fn, async_rb, cfg.batching,
             use_pallas_grad, _pallas_interpret(),
+            # kernel-resident evolve block: which backend (if any) replaced
+            # the evolve leg is baked into the fused executable, and the
+            # resident row count is baked into its score pass
+            None if block_fn is None else ("blk", block_backend, dataset.n),
             None
             if copt_impl is None
             else (
@@ -2170,7 +2306,8 @@ def device_search_one_output(
                 run_iteration_fused_donated if async_rb else run_iteration_fused
             )
             fused_step = base_fused.lower(
-                state, score_data, ecfg, score_fn, copt_impl, fin_sfn
+                state, score_data, ecfg, score_fn, copt_impl, fin_sfn,
+                block_fn=block_fn,
             ).compile()
             fused_step = PROGRAM_CACHE.put("aot", k_fused, fused_step)
         run_step = copt_step = fin_step = None
@@ -2256,7 +2393,7 @@ def device_search_one_output(
                 run_iteration_fused_donated if async_rb else run_iteration_fused
             )
             fused_step = lambda st, d: _fused_jit(  # noqa: E731
-                st, d, ecfg, score_fn, copt_impl, fin_sfn
+                st, d, ecfg, score_fn, copt_impl, fin_sfn, block_fn=block_fn
             )
             run_step = None
         else:
@@ -2328,8 +2465,18 @@ def device_search_one_output(
     if fused_step is not None and prof.enabled:
         # profiling a fused search: derive the fused wall's decomposition
         # once (probe fractions), reported as fused_iter/<leg> each iteration
+        blk_stage_fns = None
+        if block_fn is not None:
+            blk_stage_fns = tuple(
+                _make_block_fn(
+                    options.operators, options.loss, ecfg, int(dataset.n),
+                    block_backend, stages=s,
+                )
+                for s in (1, 2, 3, 4)
+            )
         fused_fracs = _probe_fused_fractions(
-            state, score_data, ecfg, score_fn, copt_impl, fin_sfn
+            state, score_data, ecfg, score_fn, copt_impl, fin_sfn,
+            block_stage_fns=blk_stage_fns,
         )
     device_evals = 0.0
     own_dev_evals = 0.0  # this process's cumulative device evals (group mode)
@@ -3114,9 +3261,31 @@ class _FleetLane:
         )
         self.need_raw = need_raw
         self.eng_dt = eng_dt
+        # kernel-resident evolve block (same resolution as the solo driver;
+        # the fleet megaprogram is always fused, so no SR_FUSED_ITER gate)
+        self.n_rows = int(dataset.n)
+        self.block_backend = None
+        blk_env = os.environ.get("SR_ENGINE_BLOCK", "")
+        if (
+            blk_env != "0"
+            and options.loss_function_jit is None
+            and eng_dt == np.float32
+            and dataset.n <= 8 * _blk_row_limit()
+        ):
+            from ..ops.evolve_block import block_eligible
+
+            if block_eligible(self.ecfg)[0]:
+                from ..ops.interp_pallas import evolve_block_supported
+
+                if evolve_block_supported(
+                    options.operators, dataset.n_features, options.loss
+                ):
+                    self.block_backend = "kernel"
+                elif blk_env == "1":
+                    self.block_backend = "reference"
         self.score_fn, self.score_data = _make_score_fn(
             Xe, ye, we, options, use_pallas, ds_key=ds_key, norm=norm_val,
-            need_raw=need_raw,
+            need_raw=need_raw, need_packed=self.block_backend is not None,
         )
         self.score_call = lambda batch: self.score_fn.jitted(
             batch, self.score_data
@@ -3394,6 +3563,7 @@ def fleet_search(
             or lane.use_pallas_grad != lead.use_pallas_grad
             or lane.copt_key != lead.copt_key
             or lane.options.jit_warmup != lead.options.jit_warmup
+            or lane.block_backend != lead.block_backend
         ):
             raise ValueError(
                 "fleet lanes must agree on async_readback/profile, the "
@@ -3403,6 +3573,14 @@ def fleet_search(
     async_rb = lead.async_rb
     copt_impl = lead.make_copt(ecfg, jit=False) if lead.make_copt else None
     fin_sfn = score_fn if ecfg.batching else None
+    block_fn = None
+    if lead.block_backend is not None:
+        # one shared closure: every lane proved the same backend/config
+        # above, and the stacked data_f vmaps through it lane-by-lane
+        block_fn = _make_block_fn(
+            lead.options.operators, lead.options.loss, ecfg, lead.n_rows,
+            lead.block_backend,
+        )
     frac_hof = float(lead.options.fraction_replaced_hof)
 
     # stacked device state + dataset: [Lb, ...] leading fleet axis (pad
@@ -3441,11 +3619,15 @@ def fleet_search(
         k_fused = (
             "fleet", Lb, ecfg, score_fn, async_rb, ecfg.batching,
             lead.use_pallas_grad, _pallas_interpret(), lead.copt_key,
+            None
+            if block_fn is None
+            else ("blk", lead.block_backend, lead.n_rows),
         )
         fused_step = PROGRAM_CACHE.get("fleet_aot", k_fused)
         if fused_step is None:
             fused_step = base_fused.lower(
-                state_f, active_dev, data_f, ecfg, score_fn, copt_impl, fin_sfn
+                state_f, active_dev, data_f, ecfg, score_fn, copt_impl,
+                fin_sfn, block_fn=block_fn,
             ).compile()
             fused_step = PROGRAM_CACHE.put("fleet_aot", k_fused, fused_step)
         k_rb = ("fleet_rb", Lb, ecfg)
@@ -3470,7 +3652,7 @@ def fleet_search(
             ).block_until_ready()
     else:
         fused_step = lambda st, act, d: base_fused(  # noqa: E731
-            st, act, d, ecfg, score_fn, copt_impl, fin_sfn
+            st, act, d, ecfg, score_fn, copt_impl, fin_sfn, block_fn=block_fn
         )
         rb_step = fleet_rb
 

@@ -1520,7 +1520,7 @@ run_finalize = functools.partial(jax.jit, static_argnames=("cfg", "score_fn"))(
 
 def _run_iteration_fused_impl(
     state: EvoState, data, cfg: EvoConfig, score_fn, copt_impl=None,
-    fin_score_fn=None, axis=None,
+    fin_score_fn=None, axis=None, block_fn=None,
 ) -> EvoState:
     """One engine iteration as a SINGLE program: evolve → (length-compacted)
     constant optimization → full-data finalize, chained inside one trace so
@@ -1535,13 +1535,25 @@ def _run_iteration_fused_impl(
     unfused driver, which only builds fin_step when batching). The chained
     computations are the SAME traced functions the split path jits
     individually, so fused results are bit-identical to the split dispatch
-    chain (pinned by tests/test_fused_iter.py)."""
+    chain (pinned by tests/test_fused_iter.py).
+
+    ``block_fn``: kernel-resident evolve leg (SR_ENGINE_BLOCK, static): an
+    unjitted ``(state, data) -> state`` closure over
+    ops/evolve_block.run_block_iteration that replaces the XLA event
+    trajectory for the evolve stage. None keeps today's bit-exact path."""
     if cfg.record_events:
         raise ValueError(
             "fused iteration does not support record_events (replay drivers "
             "read per-program logs; use the split dispatch chain)"
         )
-    state = _run_iteration_impl(state, data, cfg, score_fn, axis=axis)
+    if block_fn is not None:
+        if axis is not None:
+            raise ValueError(
+                "SR_ENGINE_BLOCK does not support the sharded island axis"
+            )
+        state = block_fn(state, data)
+    else:
+        state = _run_iteration_impl(state, data, cfg, score_fn, axis=axis)
     if copt_impl is not None:
         state = copt_impl(state, data)
     if cfg.batching and fin_score_fn is not None:
@@ -1550,7 +1562,8 @@ def _run_iteration_fused_impl(
 
 
 run_iteration_fused = functools.partial(
-    jax.jit, static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn")
+    jax.jit,
+    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn", "block_fn"),
 )(_run_iteration_fused_impl)
 
 # donated twin (see run_iteration_donated): the fused program consumes and
@@ -1558,7 +1571,7 @@ run_iteration_fused = functools.partial(
 # through every iteration with zero copies
 run_iteration_fused_donated = functools.partial(
     jax.jit,
-    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn"),
+    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn", "block_fn"),
     donate_argnums=(0,),
 )(_run_iteration_fused_impl)
 
@@ -1577,7 +1590,7 @@ def _freeze_inactive(new: EvoState, old: EvoState, active):
 
 def _run_fleet_iteration_fused_impl(
     state: EvoState, active, data, cfg: EvoConfig, score_fn, copt_impl=None,
-    fin_score_fn=None,
+    fin_score_fn=None, block_fn=None,
 ) -> EvoState:
     """N concurrent searches as ONE megaprogram per iteration: the fused
     per-iteration impl vmapped over a leading fleet axis of (EvoState,
@@ -1598,7 +1611,7 @@ def _run_fleet_iteration_fused_impl(
 
     def lane(st, act, d):
         new = _run_iteration_fused_impl(
-            st, d, cfg, score_fn, copt_impl, fin_score_fn
+            st, d, cfg, score_fn, copt_impl, fin_score_fn, block_fn=block_fn
         )
         return _freeze_inactive(new, st, act)
 
@@ -1606,14 +1619,15 @@ def _run_fleet_iteration_fused_impl(
 
 
 run_fleet_iteration_fused = functools.partial(
-    jax.jit, static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn")
+    jax.jit,
+    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn", "block_fn"),
 )(_run_fleet_iteration_fused_impl)
 
 # donated twin (see run_iteration_fused_donated): one set of stacked fleet
 # state buffers threads through every iteration with zero copies
 run_fleet_iteration_fused_donated = functools.partial(
     jax.jit,
-    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn"),
+    static_argnames=("cfg", "score_fn", "copt_impl", "fin_score_fn", "block_fn"),
     donate_argnums=(0,),
 )(_run_fleet_iteration_fused_impl)
 

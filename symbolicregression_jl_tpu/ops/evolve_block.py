@@ -1,0 +1,1016 @@
+"""Kernel-resident evolution block over the packed int16 program IR (r17).
+
+One iteration of regularized evolution — ncycles of tournament -> mutate ->
+check -> score -> accept — expressed entirely over :class:`~.flat.
+PackedPrograms` words so a whole block runs without candidates leaving the
+chip. The SAME values-based implementation (`_block_cycle` and its helpers)
+drives BOTH backends:
+
+- the **Pallas kernel** (ops/interp_pallas.make_evolve_block_fn): grid over
+  islands, population words live in VMEM, scoring reuses the loss kernel's
+  scratch-buffer slot loop;
+- the **XLA reference** (`run_block_iteration(..., kernel_fn=None)`): the
+  identical cycle driver vmapped over islands with a value-based evaluator.
+
+Only the ``eval_fn`` callback differs, and both evaluators apply the same op
+sequence to identically-shaped (8, C) row tiles, so interpret-mode kernel
+losses are bitwise equal to the reference and accept decisions agree
+deterministically (tests/test_pallas_interpret.py pins this).
+
+Mosaic cannot run jax.random's threefry, so the block derives every draw
+from a counter hash (`_blk_bits`: murmur3-style mixing of
+(seed, cycle, lane, draw-id)) — reproducible, order-independent, identical
+arithmetic on both backends. The seed comes from one `jax.random.split` of
+the engine key per iteration, so block runs stay deterministic per seed.
+
+Documented divergences from the ``_event`` XLA trajectory (opt-in via
+SR_ENGINE_BLOCK, quality-A/B'd by bench artifacts; SR_ENGINE_BLOCK=0 keeps
+today's bit-exact path):
+
+- tournament draws candidates WITH replacement (argsort of P uniforms is
+  not kernel-expressible) and picks the rank via inverse-CDF;
+- crossover and full-tree randomize are dropped (their weights fold into
+  do-nothing); the mutation set is constant-perturb / operator-swap /
+  rotate / add / insert / delete on packed words;
+- the size-frequency histogram is SNAPSHOT at block entry (cross-island
+  per-cycle merging would serialize the island grid); deltas accumulate
+  per island and merge once at block exit, as does the best-seen frontier
+  (per-size min is associative, so the frontier CONTENT matches).
+
+Eligibility is gated hard (`block_eligible`): no recorder, no batching, no
+sub-sampled eval, no custom complexity mapping, no operator/nesting/units
+constraints, f32 values only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .evolve import (
+    EvoConfig,
+    EvoState,
+    M_ADD,
+    M_CONST,
+    M_DELETE,
+    M_INSERT,
+    M_NOTHING,
+    M_OPERATOR,
+    M_RANDOMIZE,
+    M_SWAP,
+    _has_op_constraints,
+    _migrate,
+    _score_of,
+    merge_best_seen,
+)
+from .flat import (
+    KIND_BINARY,
+    KIND_CONST,
+    KIND_PAD,
+    KIND_UNARY,
+    KIND_VAR,
+    PACK_KIND_BITS,
+    PACK_KIND_MASK,
+    pack_words,
+)
+
+__all__ = [
+    "block_eligible",
+    "run_block_iteration",
+    "make_reference_eval",
+]
+
+# --------------------------------------------------------------------------
+# Counter-derived RNG: every draw is a pure hash of (seed, cycle, lane, id).
+# Draw-id table — one slot per independent decision a lane makes in a cycle.
+# Tournament draws occupy ids [0, 32); everything else is fixed below.
+# --------------------------------------------------------------------------
+D_RANK = 32
+D_KIND = 33
+D_SITE = 34
+D_CHILD = 35
+D_ACCEPT = 36
+D_C_FACTOR = 37
+D_C_INV = 38
+D_C_NEG = 39
+D_OP_UN = 40
+D_OP_BIN = 41
+D_L1_CONST = 42
+D_L1_FEAT = 43
+D_L1_N1 = 44
+D_L1_N2 = 45
+D_L2_CONST = 46
+D_L2_FEAT = 47
+D_L2_N1 = 48
+D_L2_N2 = 49
+D_M_OPB = 50
+D_M_OPU = 51
+
+
+def _fmix(x):
+    """murmur3 finalizer on uint32 (identical integer arithmetic on every
+    backend — the whole point vs jax.random inside Mosaic)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _blk_bits(seed, cycle, lane, draw: int):
+    """uint32 hash of (seed, cycle, lane, draw). ``lane`` may be a vector;
+    ``draw`` is a static python int from the D_* table."""
+    x = seed.astype(jnp.uint32) ^ (
+        jnp.uint32(0x9E3779B9) * (cycle.astype(jnp.uint32) + jnp.uint32(1))
+    )
+    x = _fmix(x)
+    x = x ^ (jnp.uint32(0x85EBCA6B) * (lane.astype(jnp.uint32) + jnp.uint32(1)))
+    x = _fmix(x)
+    x = x ^ (jnp.uint32(0xC2B2AE35) * jnp.uint32(draw + 1))
+    return _fmix(x)
+
+
+def _blk_u01(bits):
+    """[0, 1) f32 from the top 24 bits (exactly representable)."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(
+        1.0 / 16777216.0
+    )
+
+
+def _blk_normal(u1, u2):
+    """Box-Muller standard normal from two uniforms."""
+    r = jnp.sqrt(-2.0 * jnp.log(jnp.maximum(u1, jnp.float32(1e-12))))
+    return r * jnp.cos(jnp.float32(2.0 * np.pi) * u2)
+
+
+def _randint(u, n):
+    """Integer in [0, n) from u in [0, 1). ``n`` int scalar/array, >= 1."""
+    n = jnp.asarray(n, jnp.int32)
+    return jnp.minimum((u * n.astype(jnp.float32)).astype(jnp.int32), n - 1)
+
+
+# --------------------------------------------------------------------------
+# Mosaic-safe primitives: one-hot reads/gathers instead of argsort/dynamic
+# indexing. Shapes are small ([E, P], [E, N, N]) so the masked sums are
+# noise next to scoring.
+# --------------------------------------------------------------------------
+
+
+def _it(n):
+    return lax.broadcasted_iota(jnp.int32, (n,), 0)
+
+
+def _take(mat, idx):
+    """mat [..., V], idx [...] int32 -> [...]: one-hot masked-sum dynamic
+    read (exact for every dtype, inf/nan-safe — no multiplies)."""
+    V = mat.shape[-1]
+    oh = idx[..., None] == _it(V)
+    return jnp.sum(jnp.where(oh, mat, jnp.zeros((), mat.dtype)), axis=-1)
+
+
+def _gather_vec(vec, idx):
+    """vec [V], idx [...] -> [...]."""
+    oh = idx[..., None] == _it(vec.shape[0])
+    return jnp.sum(jnp.where(oh, vec, jnp.zeros((), vec.dtype)), axis=-1)
+
+
+def _gather_rows(mat, idx):
+    """mat [R, N], idx [K] -> [K, N] (row gather via one-hot masked sum)."""
+    oh = idx[:, None] == _it(mat.shape[0])  # [K, R]
+    return jnp.sum(
+        jnp.where(oh[:, :, None], mat[None, :, :], jnp.zeros((), mat.dtype)),
+        axis=1,
+    )
+
+
+def _permute_cols(mat, src, use_move):
+    """out[e, j] = mat[e, src[e, j]] where use_move[e, j] else mat[e, j].
+    The subtree-block mover every structural mutation rides."""
+    N = mat.shape[-1]
+    oh = src[:, :, None] == _it(N)  # [E, N, N]
+    g = jnp.sum(
+        jnp.where(oh, mat[:, None, :], jnp.zeros((), mat.dtype)), axis=-1
+    )
+    return jnp.where(use_move, g, mat)
+
+
+def _first_true(mask):
+    """Index of the first True along the last axis (size if none)."""
+    N = mask.shape[-1]
+    return jnp.min(jnp.where(mask, _it(N), N), axis=-1).astype(jnp.int32)
+
+
+def _cumsum_i32(mask):
+    """Inclusive cumsum of a bool mask along the last axis, int32."""
+    return jnp.cumsum(mask.astype(jnp.int32), axis=-1)
+
+
+def _pick_ranked(mask, u, count):
+    """Slot index of the ``pick``-th True in ``mask`` [E, N], where pick is
+    drawn uniformly from [0, count) (count = mask row-sums, >= 1 clamped) —
+    the cumsum-rank site chooser `_mutate_constant`/_mutate_operator use."""
+    ranks = _cumsum_i32(mask) - 1
+    pick = _randint(u, jnp.maximum(count, 1))
+    return _first_true(mask & (ranks == pick[:, None]))
+
+
+# --------------------------------------------------------------------------
+# Pointer/extent reconstruction: ONE stack pass over the packed words gives
+# lhs/rhs child slots, subtree start, and subtree depth per node. Statically
+# unrolled over N (traced once per fori body).
+# --------------------------------------------------------------------------
+
+
+def _block_pointers(words, length):
+    """words [B, N] int32 packed, length [B] -> (lhs, rhs, start, depth),
+    each [B, N] int32. depth[i] is the subtree depth rooted at i (leaf=1);
+    garbage-free only at live slots of stack-sound rows (mutations preserve
+    soundness by construction; verify_packed_programs pins it in tests)."""
+    B, N = words.shape
+    D = N // 2 + 2
+    kind = words & PACK_KIND_MASK
+    iota_n = _it(N)
+    iota_d = _it(D)
+    live_all = iota_n[None, :] < length[:, None]
+
+    sp = jnp.zeros((B,), jnp.int32)
+    st_slot = jnp.zeros((B, D), jnp.int32)
+    st_start = jnp.zeros((B, D), jnp.int32)
+    st_depth = jnp.zeros((B, D), jnp.int32)
+    lhs = jnp.zeros((B, N), jnp.int32)
+    rhs = jnp.zeros((B, N), jnp.int32)
+    start = jnp.zeros((B, N), jnp.int32)
+    depth = jnp.zeros((B, N), jnp.int32)
+
+    for i in range(N):
+        k = kind[:, i]
+        live = live_all[:, i]
+        is_leaf = (k == KIND_CONST) | (k == KIND_VAR)
+        is_un = k == KIND_UNARY
+        is_bin = k == KIND_BINARY
+        t1 = jnp.maximum(sp - 1, 0)
+        t2 = jnp.maximum(sp - 2, 0)
+        top1s = _take(st_slot, t1)
+        top2s = _take(st_slot, t2)
+        top1a = _take(st_start, t1)
+        top2a = _take(st_start, t2)
+        top1d = _take(st_depth, t1)
+        top2d = _take(st_depth, t2)
+        lhs_i = jnp.where(is_un, top1s, jnp.where(is_bin, top2s, 0))
+        rhs_i = jnp.where(is_bin, top1s, 0)
+        start_i = jnp.where(
+            is_leaf, i, jnp.where(is_un, top1a, top2a)
+        ).astype(jnp.int32)
+        depth_i = jnp.where(
+            is_leaf,
+            1,
+            jnp.where(is_un, top1d + 1, jnp.maximum(top1d, top2d) + 1),
+        ).astype(jnp.int32)
+        new_sp = sp + jnp.where(is_leaf, 1, jnp.where(is_bin, -1, 0))
+        wr = live[:, None] & (iota_d[None, :] == (new_sp - 1)[:, None])
+        st_slot = jnp.where(wr, i, st_slot)
+        st_start = jnp.where(wr, start_i[:, None], st_start)
+        st_depth = jnp.where(wr, depth_i[:, None], st_depth)
+        sp = jnp.where(live, new_sp, sp)
+        col = iota_n[None, :] == i
+        lhs = jnp.where(col & live[:, None], lhs_i[:, None], lhs)
+        rhs = jnp.where(col & live[:, None], rhs_i[:, None], rhs)
+        start = jnp.where(col & live[:, None], start_i[:, None], start)
+        depth = jnp.where(col & live[:, None], depth_i[:, None], depth)
+    return lhs, rhs, start, depth
+
+
+def unpack_pointers_jnp(words, length):
+    """Traced FlatTrees fields from packed words: (kind, op, lhs, rhs, feat)
+    int32 [B, N]. The in-program half of the pack-out (consts pass through)."""
+    w32 = words.astype(jnp.int32)
+    kind = w32 & PACK_KIND_MASK
+    payload = w32 >> PACK_KIND_BITS
+    op = jnp.where((kind == KIND_UNARY) | (kind == KIND_BINARY), payload, 0)
+    feat = jnp.where(kind == KIND_VAR, payload, 0)
+    lhs, rhs, _, _ = _block_pointers(w32, length)
+    return kind, op, lhs, rhs, feat
+
+
+def _word(kind, payload):
+    return (kind | (payload << PACK_KIND_BITS)).astype(jnp.int32)
+
+# --------------------------------------------------------------------------
+# The mutation set, on packed words as values. Every mutation computes its
+# full output and the chosen kind selects afterwards — the exact evaluation
+# model the XLA path's vmapped lax.switch has (every branch traces), so the
+# block costs the same work per event and stays branch-free for Mosaic.
+# Each returns (words', consts', length') with slots >= length' zeroed.
+# --------------------------------------------------------------------------
+
+
+def _mut_constant(words, consts, length, kind, live, u_site, u_fac, u_inv, u_neg, cfg, temperature):
+    """Mirror of evolve._mutate_constant on the constants lane."""
+    is_c = live & (kind == KIND_CONST)
+    n_c = jnp.sum(is_c, axis=-1)
+    p = _pick_ranked(is_c, u_site, n_c)
+    hits = is_c & (_it(words.shape[-1])[None, :] == p[:, None])
+    max_change = cfg.perturbation_factor * temperature + 1.0 + 0.1
+    factor = jnp.power(jnp.float32(max_change), u_fac)
+    factor = jnp.where(u_inv < 0.5, factor, 1.0 / factor)
+    neg = u_neg < cfg.probability_negate_constant
+    scale = jnp.where(
+        hits,
+        (factor * jnp.where(neg, -1.0, 1.0))[:, None],
+        jnp.ones((), consts.dtype),
+    )
+    newc = jnp.where(n_c[:, None] > 0, consts * scale, consts)
+    return words, newc, length
+
+
+def _mut_operator(words, consts, length, kind, live, u_site, u_un, u_bin, cfg):
+    """Mirror of evolve._mutate_operator: same-arity operator swap."""
+    is_op = live & (kind >= KIND_UNARY)
+    n_op = jnp.sum(is_op, axis=-1)
+    p = _pick_ranked(is_op, u_site, n_op)
+    hits = is_op & (_it(words.shape[-1])[None, :] == p[:, None])
+    new_un = _randint(u_un, max(cfg.n_unary, 1))
+    new_bin = _randint(u_bin, max(cfg.n_binary, 1))
+    payload = jnp.where(kind == KIND_UNARY, new_un[:, None], new_bin[:, None])
+    new_words = jnp.where(
+        hits & (n_op[:, None] > 0), _word(kind, payload), words
+    )
+    return new_words, consts, length
+
+
+def _mut_rotate(words, consts, length, kind, live, lhs, rhs, start, u_site, cfg):
+    """Mirror of evolve._swap_operands: swap the child blocks of one random
+    binary node. Pure block move — pointers recompute, no fixups."""
+    N = words.shape[-1]
+    iota = _it(N)[None, :]
+    is_bin = live & (kind == KIND_BINARY)
+    n_b = jnp.sum(is_bin, axis=-1)
+    p = _pick_ranked(is_bin, u_site, n_b)
+    l_root = _take(lhs, p)
+    r_root = _take(rhs, p)
+    sizes_l = l_root - _take(start, l_root) + 1
+    sizes_r = r_root - _take(start, r_root) + 1
+    al = l_root - sizes_l + 1
+    src = jnp.clip(
+        jnp.where(
+            iota < (al + sizes_r)[:, None],
+            iota + sizes_l[:, None],
+            iota - sizes_r[:, None],
+        ),
+        0,
+        N - 1,
+    )
+    use_move = (iota >= al[:, None]) & (iota < p[:, None])
+    new_words = _permute_cols(words, src, use_move)
+    new_consts = _permute_cols(consts, src, use_move)
+    ok = n_b[:, None] > 0
+    return (
+        jnp.where(ok, new_words, words),
+        jnp.where(ok, new_consts, consts),
+        length,
+    )
+
+
+def _leaf_draws(seed, cycle, lane, cfg, d_const, d_feat, d_n1, d_n2):
+    """One random leaf as (word, const): 50/50 const/feature, val ~ N(0,1)
+    (mirror of evolve._leaf_material)."""
+    u_c = _blk_u01(_blk_bits(seed, cycle, lane, d_const))
+    u_f = _blk_u01(_blk_bits(seed, cycle, lane, d_feat))
+    u_n1 = _blk_u01(_blk_bits(seed, cycle, lane, d_n1))
+    u_n2 = _blk_u01(_blk_bits(seed, cycle, lane, d_n2))
+    is_const = u_c < 0.5
+    if cfg.nfeatures <= 0:
+        is_const = jnp.ones_like(is_const)
+    feat = _randint(u_f, max(cfg.nfeatures, 1))
+    word = jnp.where(
+        is_const, jnp.int32(KIND_CONST), _word(jnp.int32(KIND_VAR), feat)
+    )
+    cval = jnp.where(is_const, _blk_normal(u_n1, u_n2), 0.0)
+    return word, cval
+
+
+def _use_bin_draw(u, cfg):
+    """Binary-vs-unary material choice with the degenerate-table overrides
+    evolve._add_node/_insert_node apply."""
+    use_bin = u < (cfg.n_binary / max(cfg.n_binary + cfg.n_unary, 1))
+    if cfg.n_unary == 0:
+        use_bin = jnp.ones_like(use_bin)
+    if cfg.n_binary == 0:
+        use_bin = jnp.zeros_like(use_bin)
+    return use_bin
+
+
+def _mut_add(words, consts, length, kind, live, seed, cycle, lane, u_site, u_child, cfg):
+    """Mirror of evolve._add_node: replace a random leaf with
+    binary(leaf, leaf) or unary(leaf) material."""
+    N = words.shape[-1]
+    iota = _it(N)[None, :]
+    is_leaf = live & ((kind == KIND_CONST) | (kind == KIND_VAR))
+    n_l = jnp.sum(is_leaf, axis=-1)
+    p = _pick_ranked(is_leaf, u_site, n_l)
+    use_bin = _use_bin_draw(u_child, cfg)
+    w1, c1 = _leaf_draws(seed, cycle, lane, cfg, D_L1_CONST, D_L1_FEAT, D_L1_N1, D_L1_N2)
+    w2, c2 = _leaf_draws(seed, cycle, lane, cfg, D_L2_CONST, D_L2_FEAT, D_L2_N1, D_L2_N2)
+    opb = _randint(_blk_u01(_blk_bits(seed, cycle, lane, D_M_OPB)), max(cfg.n_binary, 1))
+    opu = _randint(_blk_u01(_blk_bits(seed, cycle, lane, D_M_OPU)), max(cfg.n_unary, 1))
+    m_len = jnp.where(use_bin, 3, 2).astype(jnp.int32)
+    # material slot words: [leaf1, leaf2, binop] or [leaf1, unop]
+    mat1 = jnp.where(use_bin, w2, _word(jnp.int32(KIND_UNARY), opu))
+    mat2 = _word(jnp.int32(KIND_BINARY), opb)
+    matc1 = jnp.where(use_bin, c2, 0.0)
+    # tail (old slots > p) shifts up by m_len - 1
+    shift = (m_len - 1)[:, None]
+    src = jnp.clip(iota - shift, 0, N - 1)
+    tail = iota >= (p[:, None] + m_len[:, None])
+    new_words = _permute_cols(words, src, tail)
+    new_consts = _permute_cols(consts, src, tail)
+    at0 = iota == p[:, None]
+    at1 = iota == (p + 1)[:, None]
+    at2 = (iota == (p + 2)[:, None]) & use_bin[:, None]
+    new_words = jnp.where(at0, w1[:, None], new_words)
+    new_words = jnp.where(at1, mat1[:, None], new_words)
+    new_words = jnp.where(at2, mat2[:, None], new_words)
+    new_consts = jnp.where(at0, c1[:, None], new_consts)
+    new_consts = jnp.where(at1, matc1[:, None], new_consts)
+    new_consts = jnp.where(at2, 0.0, new_consts)
+    new_len = length + m_len - 1
+    ok = (n_l > 0) & (new_len <= N)
+    return (
+        jnp.where(ok[:, None], new_words, words),
+        jnp.where(ok[:, None], new_consts, consts),
+        jnp.where(ok, new_len, length),
+    )
+
+
+def _mut_insert(words, consts, length, start, seed, cycle, lane, u_site, u_child, cfg):
+    """Mirror of evolve._insert_node: wrap a random subtree in a fresh
+    operator — unary directly, binary with a new leaf as second child."""
+    N = words.shape[-1]
+    iota = _it(N)[None, :]
+    p = _randint(u_site, jnp.maximum(length, 1))
+    use_bin = _use_bin_draw(u_child, cfg)
+    wl, cl = _leaf_draws(seed, cycle, lane, cfg, D_L1_CONST, D_L1_FEAT, D_L1_N1, D_L1_N2)
+    opb = _randint(_blk_u01(_blk_bits(seed, cycle, lane, D_M_OPB)), max(cfg.n_binary, 1))
+    opu = _randint(_blk_u01(_blk_bits(seed, cycle, lane, D_M_OPU)), max(cfg.n_unary, 1))
+    shift = jnp.where(use_bin, 2, 1).astype(jnp.int32)
+    op_word = jnp.where(
+        use_bin,
+        _word(jnp.int32(KIND_BINARY), opb),
+        _word(jnp.int32(KIND_UNARY), opu),
+    )
+    # block [start[p], p] stays in place; leaf (binary only) lands at p+1,
+    # the wrapping op at p+shift; the tail shifts up by shift
+    src = jnp.clip(iota - shift[:, None], 0, N - 1)
+    tail = iota > (p + shift)[:, None]
+    new_words = _permute_cols(words, src, tail)
+    new_consts = _permute_cols(consts, src, tail)
+    at_leaf = (iota == (p + 1)[:, None]) & use_bin[:, None]
+    at_op = iota == (p + shift)[:, None]
+    new_words = jnp.where(at_leaf, wl[:, None], new_words)
+    new_consts = jnp.where(at_leaf, cl[:, None], new_consts)
+    new_words = jnp.where(at_op, op_word[:, None], new_words)
+    new_consts = jnp.where(at_op, 0.0, new_consts)
+    new_len = length + shift
+    ok = new_len <= N
+    return (
+        jnp.where(ok[:, None], new_words, words),
+        jnp.where(ok[:, None], new_consts, consts),
+        jnp.where(ok, new_len, length),
+    )
+
+
+def _mut_delete(words, consts, length, kind, live, lhs, rhs, start, u_site, u_child, cfg):
+    """Mirror of evolve._delete_node: splice a random operator out,
+    promoting one of its children (right w.p. 0.5 for binary)."""
+    N = words.shape[-1]
+    iota = _it(N)[None, :]
+    is_op = live & (kind >= KIND_UNARY)
+    n_op = jnp.sum(is_op, axis=-1)
+    p = _pick_ranked(is_op, u_site, n_op)
+    keep_right = (_take(kind, p) == KIND_BINARY) & (u_child < 0.5)
+    child = jnp.where(keep_right, _take(rhs, p), _take(lhs, p))
+    ca = _take(start, child)
+    clen = child - ca + 1
+    sub_a = _take(start, p)
+    sub_len = p - sub_a + 1
+    removed = sub_len - clen
+    in_child = (iota >= sub_a[:, None]) & (iota < (sub_a + clen)[:, None])
+    src = jnp.where(
+        in_child,
+        iota - sub_a[:, None] + ca[:, None],
+        iota + removed[:, None],
+    )
+    src = jnp.clip(src, 0, N - 1)
+    use_move = iota >= sub_a[:, None]
+    new_words = _permute_cols(words, src, use_move)
+    new_consts = _permute_cols(consts, src, use_move)
+    new_len = length - removed
+    ok = n_op > 0
+    return (
+        jnp.where(ok[:, None], new_words, words),
+        jnp.where(ok[:, None], new_consts, consts),
+        jnp.where(ok, new_len, length),
+    )
+
+
+# --------------------------------------------------------------------------
+# Tournament (documented divergence: WITH replacement + inverse-CDF rank;
+# the XLA path's distinct-candidate argsort is not kernel-expressible).
+# --------------------------------------------------------------------------
+
+
+def _blk_tournament(score, length, fnorm, seed, cycle, lane, cfg):
+    """Winner member index in [0, P) per lane. score/length are [P]
+    population columns; lane is the [E] lane-id vector."""
+    n = cfg.tournament_n
+    P = cfg.pop_size
+    cand = jnp.stack(
+        [
+            _randint(_blk_u01(_blk_bits(seed, cycle, lane, d)), P)
+            for d in range(n)
+        ],
+        axis=-1,
+    )  # [E, n]
+    s = jax.vmap(lambda c: _gather_vec(score, c))(cand)
+    if cfg.use_frequency_in_tournament:
+        sizes = jnp.clip(
+            jax.vmap(lambda c: _gather_vec(length, c))(cand), 0, cfg.maxsize
+        )
+        s = s * jnp.exp(
+            cfg.adaptive_parsimony_scaling * jax.vmap(
+                lambda z: _gather_vec(fnorm, z)
+            )(sizes)
+        )
+    # inverse-CDF over the STATIC rank weights, accumulated from python
+    # float scalars — array constants would be captured by the Pallas
+    # kernel trace, which rejects them
+    w = np.asarray(cfg.tournament_weights, np.float64)
+    cum = np.cumsum(w / np.sum(w))
+    u = _blk_u01(_blk_bits(seed, cycle, lane, D_RANK))
+    rank = jnp.zeros_like(u, jnp.int32)
+    for k in range(n):
+        rank = rank + (u >= jnp.float32(cum[k])).astype(jnp.int32)
+    rank = jnp.clip(rank, 0, n - 1)
+    # stable rank of each candidate's adjusted score (pairwise count — the
+    # kernel-safe argsort for tiny n)
+    less = (s[:, :, None] > s[:, None, :]).astype(jnp.int32)  # j beats i
+    eq_before = (
+        (s[:, :, None] == s[:, None, :])
+        & (_it(n)[None, None, :] < _it(n)[None, :, None])
+    ).astype(jnp.int32)
+    crank = jnp.sum(less + eq_before, axis=-1)  # [E, n]
+    pos = _first_true(crank == rank[:, None])
+    return jax.vmap(_gather_vec)(cand, jnp.clip(pos, 0, n - 1))
+
+
+def _oldest_slots(birth, E):
+    """Stable ranks of ``birth`` [P]; member p hosts event e iff rank == e.
+    Returns ev [P] int32 (event index, or E where the member survives)."""
+    P = birth.shape[0]
+    less = (birth[None, :] < birth[:, None]).astype(jnp.int32)
+    eq_before = (
+        (birth[None, :] == birth[:, None]) & (_it(P)[None, :] < _it(P)[:, None])
+    ).astype(jnp.int32)
+    rank = jnp.sum(less + eq_before, axis=-1)  # [P]
+    return jnp.where(rank < E, rank, E)
+
+
+# --------------------------------------------------------------------------
+# One evolution cycle over one island's packed population. Pure values-in /
+# values-out jnp — the Pallas kernel body and the XLA reference both call
+# this exact function, so backend parity is parity of eval_fn alone.
+# --------------------------------------------------------------------------
+
+
+def _block_cycle(carry, cycle, isl, seed, step0, curmaxsize, fnorm, norm, cfg,
+                 eval_fn, stages):
+    (words, consts, length, loss, score, birth, fd,
+     bs_loss, bs_w, bs_c, bs_len) = carry
+    P, N = words.shape
+    E = cfg.events_per_cycle
+    lane = isl * jnp.int32(E) + _it(E)
+    iota_n = _it(N)[None, :]
+
+    if cfg.annealing:
+        temperature = jnp.float32(1.0) - cycle.astype(jnp.float32) / max(
+            cfg.ncycles - 1, 1
+        )
+    else:
+        temperature = jnp.float32(1.0)
+
+    # ---- stage 1: tournament + mutation draws + mutate + canonicalize ----
+    parent = _blk_tournament(score, length, fnorm, seed, cycle, lane, cfg)
+    pw = _gather_rows(words, parent)  # [E, N] int32
+    pc = _gather_rows(consts, parent)
+    plen = _gather_vec(length, parent)
+    ploss = _gather_vec(loss, parent)
+    pscore = _gather_vec(score, parent)
+    kind = pw & PACK_KIND_MASK
+    live = iota_n < plen[:, None]
+    kind = jnp.where(live, kind, KIND_PAD)
+
+    lhs, rhs, start, _depth = _block_pointers(pw, plen)
+
+    # conditioned mutation weights (mirror _condition_weights; randomize and
+    # crossover fold into do-nothing — documented divergence)
+    base = np.asarray(cfg.mutation_weights, np.float32).copy()
+    base[M_NOTHING] += base[M_RANDOMIZE]
+    base[M_RANDOMIZE] = 0.0
+    n_const = jnp.sum(live & (kind == KIND_CONST), axis=-1)
+    n_ops = jnp.sum(kind >= KIND_UNARY, axis=-1)
+    n_bin = jnp.sum(kind == KIND_BINARY, axis=-1)
+    at_max = plen >= curmaxsize
+    # per-kind weight columns from python float scalars (array constants
+    # would be captured by the Pallas kernel trace, which rejects them)
+    cols = [jnp.full((E,), float(base[m]), jnp.float32) for m in range(8)]
+    cols[M_OPERATOR] = jnp.where(n_ops == 0, 0.0, cols[M_OPERATOR])
+    cols[M_SWAP] = jnp.where(n_bin == 0, 0.0, cols[M_SWAP])
+    cols[M_DELETE] = jnp.where(n_ops == 0, 0.0, cols[M_DELETE])
+    cols[M_CONST] = jnp.where(
+        n_const == 0,
+        0.0,
+        cols[M_CONST] * jnp.minimum(8.0, n_const.astype(jnp.float32)) / 8.0,
+    )
+    cols[M_ADD] = jnp.where(at_max, 0.0, cols[M_ADD])
+    cols[M_INSERT] = jnp.where(at_max, 0.0, cols[M_INSERT])
+    w = jnp.stack(cols, axis=-1)  # [E, 8]
+    w = w.at[:, M_NOTHING].add(
+        jnp.where(jnp.sum(w, axis=-1) <= 0, 1.0, 0.0)
+    )
+    cum_w = jnp.cumsum(w, axis=-1)
+    u_kind = _blk_u01(_blk_bits(seed, cycle, lane, D_KIND))
+    kidx = jnp.clip(
+        jnp.sum(
+            ((u_kind * cum_w[:, -1])[:, None] >= cum_w).astype(jnp.int32),
+            axis=-1,
+        ),
+        0,
+        7,
+    )
+
+    u_site = _blk_u01(_blk_bits(seed, cycle, lane, D_SITE))
+    u_child = _blk_u01(_blk_bits(seed, cycle, lane, D_CHILD))
+    u_fac = _blk_u01(_blk_bits(seed, cycle, lane, D_C_FACTOR))
+    u_inv = _blk_u01(_blk_bits(seed, cycle, lane, D_C_INV))
+    u_neg = _blk_u01(_blk_bits(seed, cycle, lane, D_C_NEG))
+    u_un = _blk_u01(_blk_bits(seed, cycle, lane, D_OP_UN))
+    u_bin = _blk_u01(_blk_bits(seed, cycle, lane, D_OP_BIN))
+
+    muts = {
+        M_CONST: _mut_constant(
+            pw, pc, plen, kind, live, u_site, u_fac, u_inv, u_neg, cfg,
+            temperature,
+        ),
+        M_OPERATOR: _mut_operator(
+            pw, pc, plen, kind, live, u_site, u_un, u_bin, cfg
+        ),
+        M_SWAP: _mut_rotate(
+            pw, pc, plen, kind, live, lhs, rhs, start, u_site, cfg
+        ),
+        M_ADD: _mut_add(
+            pw, pc, plen, kind, live, seed, cycle, lane, u_site, u_child, cfg
+        ),
+        M_INSERT: _mut_insert(
+            pw, pc, plen, start, seed, cycle, lane, u_site, u_child, cfg
+        ),
+        M_DELETE: _mut_delete(
+            pw, pc, plen, kind, live, lhs, rhs, start, u_site, u_child, cfg
+        ),
+    }
+    cw, cc, clen = pw, pc, plen  # M_NOTHING / M_RANDOMIZE base
+    for m, (mw, mc, ml) in muts.items():
+        sel = (kidx == m)[:, None]
+        cw = jnp.where(sel, mw, cw)
+        cc = jnp.where(sel, mc, cc)
+        clen = jnp.where(kidx == m, ml, clen)
+    # pad canonicalization: gathers can drag live garbage into tails, and
+    # both the packed invariants and kernel/reference parity depend on
+    # slots >= length being exactly zero
+    tail = iota_n >= clen[:, None]
+    cw = jnp.where(tail, 0, cw)
+    cc = jnp.where(tail, 0.0, cc)
+
+    if stages < 2:
+        chk = (
+            jnp.sum(cw.astype(jnp.float32))
+            + jnp.sum(cc)
+            + jnp.sum(clen.astype(jnp.float32))
+        )
+        loss = jnp.where(jnp.isnan(chk), chk, loss)
+        return (words, consts, length, loss, score, birth, fd,
+                bs_loss, bs_w, bs_c, bs_len)
+
+    # ---- stage 2: candidate pointer pass + constraint/complexity check ----
+    _, _, _, cdepth = _block_pointers(cw, clen)
+    root_depth = _take(cdepth, jnp.maximum(clen - 1, 0))
+    ok = (clen <= curmaxsize) & (clen <= N) & (root_depth <= cfg.maxdepth)
+    vw = jnp.where(ok[:, None], cw, pw)
+    vc = jnp.where(ok[:, None], cc, pc)
+    vlen = jnp.where(ok, clen, plen)
+
+    if stages < 3:
+        chk = jnp.sum(ok.astype(jnp.float32)) + jnp.sum(
+            vw.astype(jnp.float32)
+        )
+        loss = jnp.where(jnp.isnan(chk), chk, loss)
+        return (words, consts, length, loss, score, birth, fd,
+                bs_loss, bs_w, bs_c, bs_len)
+
+    # ---- stage 3: loss scoring ----
+    loss1 = eval_fn(vw, vc, vlen)  # [E]
+    score1 = _score_of(loss1, vlen.astype(jnp.float32), cfg, norm)
+
+    if stages < 4:
+        chk = jnp.sum(loss1)
+        loss = jnp.where(jnp.isnan(chk), chk, loss)
+        return (words, consts, length, loss, score, birth, fd,
+                bs_loss, bs_w, bs_c, bs_len)
+
+    # ---- stage 4: annealing-gated accept + oldest-first replacement ----
+    sz_old = jnp.clip(plen, 0, cfg.maxsize)
+    sz_new = jnp.clip(vlen, 0, cfg.maxsize)
+    prob = jnp.ones((E,), jnp.float32)
+    if cfg.annealing:
+        # temperature hits exactly 0 on the final cycle: IEEE inf/0
+        # semantics match the XLA path (no epsilon guard)
+        prob = prob * jnp.exp(-(score1 - pscore) / (cfg.alpha * temperature))
+    if cfg.use_frequency:
+        old_f = jnp.maximum(_gather_vec(fnorm, sz_old), 1e-6)
+        new_f = jnp.maximum(_gather_vec(fnorm, sz_new), 1e-6)
+        prob = prob * (old_f / new_f)
+    u_acc = _blk_u01(_blk_bits(seed, cycle, lane, D_ACCEPT))
+    accept = ~(prob < u_acc) & jnp.isfinite(loss1) & ok
+
+    bw = jnp.where(accept[:, None], vw, pw)
+    bc = jnp.where(accept[:, None], vc, pc)
+    blen = jnp.where(accept, vlen, plen)
+    bloss = jnp.where(accept, loss1, ploss)
+    bscore = jnp.where(accept, score1, pscore)
+
+    # insert ALWAYS (parent copy on reject) over the E oldest members
+    ev = _oldest_slots(birth, E)  # [P] event id or E
+    hit = ev < E
+    evc = jnp.clip(ev, 0, E - 1)
+    words = jnp.where(hit[:, None], _gather_rows(bw, evc), words)
+    consts = jnp.where(hit[:, None], _gather_rows(bc, evc), consts)
+    length = jnp.where(hit, _gather_vec(blen, evc), length)
+    loss = jnp.where(hit, _gather_vec(bloss, evc), loss)
+    score = jnp.where(hit, _gather_vec(bscore, evc), score)
+    birth = jnp.where(hit, step0 + cycle, birth)
+
+    # frequency delta (accepted inserts only), merged cross-island at exit
+    S1 = fd.shape[0]
+    oh_f = (sz_new[:, None] == _it(S1)[None, :]) & accept[:, None]
+    fd = fd + jnp.sum(oh_f.astype(jnp.float32), axis=0)
+
+    # best-seen per complexity over ALL finite valid candidates (incl.
+    # rejected), first-argmin tie-break like merge_best_seen
+    valid = jnp.isfinite(loss1) & ok
+    m_se = valid[None, :] & (sz_new[None, :] == _it(S1)[:, None])  # [S1, E]
+    loss_se = jnp.where(m_se, loss1[None, :], jnp.inf)
+    min_s = jnp.min(loss_se, axis=-1)
+    e_star = jnp.clip(_first_true(loss_se == min_s[:, None]), 0, E - 1)
+    better = min_s < bs_loss
+    bs_loss = jnp.where(better, min_s, bs_loss)
+    bs_w = jnp.where(better[:, None], _gather_rows(vw, e_star), bs_w)
+    bs_c = jnp.where(better[:, None], _gather_rows(vc, e_star), bs_c)
+    bs_len = jnp.where(better, _gather_vec(vlen, e_star), bs_len)
+
+    return (words, consts, length, loss, score, birth, fd,
+            bs_loss, bs_w, bs_c, bs_len)
+
+
+# --------------------------------------------------------------------------
+# XLA reference evaluator: value-based twin of the Pallas loss kernel's
+# scratch-slot loop. Identical op sequence on identically-shaped (8, C) row
+# tiles (all ops computed, then selected — the value-level equivalent of the
+# kernel's pl.when predicated writes), so losses agree at f32 tolerance and
+# accept decisions agree deterministically.
+# --------------------------------------------------------------------------
+
+
+def make_reference_eval(opset, loss_elem, Xr, yr, wr, R: int):
+    """Build eval_fn(words, consts, length) -> loss [E] against the packed
+    row tile (Xr [F*8, C], yr/wr [8, C], R true rows). Works under vmap."""
+    unary_fns = [op.kernel_fn or op.fn for op in opset.unary]
+    binary_fns = [op.kernel_fn or op.fn for op in opset.binary]
+    F8, C = Xr.shape
+    F = F8 // 8
+    X3 = jnp.asarray(Xr).reshape(F, 8, C)
+    sub = lax.broadcasted_iota(jnp.int32, (8, C), 0)
+    col = lax.broadcasted_iota(jnp.int32, (8, C), 1)
+    mask = sub * C + col < R
+
+    def eval_fn(words, consts, length):
+        E, N = words.shape
+        kind = words & PACK_KIND_MASK
+        payload = words >> PACK_KIND_BITS
+        lhs, rhs, _start, _depth = _block_pointers(words, length)
+        buf = jnp.zeros((E, N, 8, C), jnp.float32)
+        for i in range(N):
+            k_i = kind[:, i]
+            lv = jnp.take_along_axis(
+                buf, lhs[:, i][:, None, None, None], axis=1
+            )[:, 0]
+            rv = jnp.take_along_axis(
+                buf, rhs[:, i][:, None, None, None], axis=1
+            )[:, 0]
+            xv = jnp.take(X3, jnp.clip(payload[:, i], 0, F - 1), axis=0)
+            val = jnp.where(
+                (k_i == KIND_CONST)[:, None, None], consts[:, i][:, None, None], 0.0
+            )
+            val = jnp.where((k_i == KIND_VAR)[:, None, None], xv, val)
+            for k, fn in enumerate(unary_fns):
+                sel = (k_i == KIND_UNARY) & (payload[:, i] == k)
+                val = jnp.where(sel[:, None, None], fn(lv), val)
+            for k, fn in enumerate(binary_fns):
+                sel = (k_i == KIND_BINARY) & (payload[:, i] == k)
+                val = jnp.where(sel[:, None, None], fn(lv, rv), val)
+            buf = buf.at[:, i].set(val)
+        pred = jnp.take_along_axis(
+            buf, jnp.maximum(length - 1, 0)[:, None, None, None], axis=1
+        )[:, 0]  # [E, 8, C]
+        elem = loss_elem(pred, yr)
+        loss_part = jnp.sum(jnp.where(mask, elem * wr, 0.0), axis=(1, 2))
+        nonfin = jnp.sum(
+            jnp.where(mask & ~jnp.isfinite(pred), 1.0, 0.0), axis=(1, 2)
+        )
+        wsum = jnp.sum(jnp.where(mask, wr, 0.0))
+        return jnp.where(
+            (nonfin == 0) & (wsum > 0),
+            loss_part / jnp.maximum(wsum, 1e-30),
+            jnp.inf,
+        )
+
+    return eval_fn
+
+
+# --------------------------------------------------------------------------
+# Island wrapper, eligibility, and the iteration entry point
+# --------------------------------------------------------------------------
+
+
+def _island_block(pop, isl, seed, step0, curmaxsize, fnorm, norm, cfg,
+                  eval_fn, stages):
+    """Run cfg.ncycles cycles over ONE island. ``pop`` = (words i32 [P,N],
+    consts [P,N], length, loss, score, birth [P]). Returns the 11-tuple
+    block carry (population + freq delta + per-island best-seen)."""
+    words, consts, length, loss, score, birth = pop
+    P, N = words.shape
+    S1 = cfg.maxsize + 1
+    carry0 = (
+        words, consts, length, loss, score, birth,
+        jnp.zeros((S1,), jnp.float32),          # freq delta
+        jnp.full((S1,), jnp.inf, jnp.float32),  # best-seen loss
+        jnp.zeros((S1, N), jnp.int32),          # best-seen words
+        jnp.zeros((S1, N), jnp.float32),        # best-seen consts
+        jnp.zeros((S1,), jnp.int32),            # best-seen length
+    )
+
+    def body(cycle, carry):
+        return _block_cycle(
+            carry, jnp.asarray(cycle, jnp.int32), isl, seed, step0,
+            curmaxsize, fnorm, norm, cfg, eval_fn, stages,
+        )
+
+    return lax.fori_loop(0, cfg.ncycles, body, carry0)
+
+
+def block_eligible(cfg: EvoConfig):
+    """(ok, reason): can the kernel-resident block replace the XLA event
+    trajectory for this engine config? Mirrors the SR_FUSED_ITER-style
+    auto-off gates; data-level gates (row count) live in device_search."""
+    if cfg.record_events:
+        return False, "recorder mode needs the per-event XLA log"
+    if cfg.batching:
+        return False, "minibatch scoring draws per-cycle row subsets"
+    if cfg.eval_fraction < 1.0:
+        return False, "fractional eval accounting"
+    if cfg.complexity_table is not None:
+        return False, "custom complexity mapping"
+    if _has_op_constraints(cfg) or cfg.nested_constraints:
+        return False, "operator argument/nesting constraints"
+    if cfg.units_check:
+        return False, "dimensional analysis"
+    if cfg.mutation_attempts > 1:
+        return False, "multi-attempt mutation retries"
+    if cfg.val_dtype != "float32":
+        return False, "f64 engine (kernels are f32-only)"
+    if cfg.events_per_cycle > cfg.pop_size:
+        return False, "events_per_cycle exceeds pop_size"
+    return True, ""
+
+
+def run_block_iteration(state: EvoState, data, cfg: EvoConfig, *,
+                        eval_fn=None, kernel_fn=None, stages: int = 4):
+    """One engine iteration via the kernel-resident block. Drop-in for
+    `_run_iteration_fused_impl`'s evolve leg when `block_eligible(cfg)`.
+
+    Exactly one of ``kernel_fn`` (the Pallas block from
+    interp_pallas.make_evolve_block_fn) or ``eval_fn`` (the XLA reference
+    evaluator from make_reference_eval) must be provided. Trace-time only —
+    callers jit."""
+    I, P, N = state.kind.shape
+    S1 = cfg.maxsize + 1
+
+    key, k_blk = jax.random.split(state.key)
+    kd = (
+        k_blk
+        if jnp.issubdtype(k_blk.dtype, jnp.integer)
+        else jax.random.key_data(k_blk)
+    )
+    kd = kd.reshape(-1).astype(jnp.uint32)
+    seed = kd[0] ^ kd[1]
+
+    if cfg.warmup_maxsize_by > 0:
+        frac_done = state.iteration.astype(jnp.float32) / max(cfg.niterations, 1)
+        in_warmup = frac_done / cfg.warmup_maxsize_by
+        curmaxsize = jnp.minimum(
+            3 + (in_warmup * (cfg.maxsize - 3)).astype(jnp.int32), cfg.maxsize
+        )
+    else:
+        curmaxsize = jnp.asarray(cfg.maxsize, jnp.int32)
+
+    # size-frequency histogram SNAPSHOT (documented divergence: per-cycle
+    # cross-island updates would serialize the island grid)
+    fnorm = state.freq / jnp.maximum(jnp.sum(state.freq), 1e-30)
+    norm = data.norm
+
+    w16, consts = pack_words(
+        state.kind, state.op, state.feat, state.val, xp=jnp
+    )
+    words = w16.astype(jnp.int32)
+    consts = consts.astype(jnp.float32)
+    pop = (
+        words, consts, state.length, state.loss.astype(jnp.float32),
+        state.score.astype(jnp.float32), state.birth,
+    )
+
+    if kernel_fn is not None:
+        out = kernel_fn(
+            *pop, fnorm, seed, state.step, curmaxsize,
+            jnp.asarray(norm, jnp.float32),
+        )
+    else:
+        if eval_fn is None:
+            raise ValueError("run_block_iteration needs eval_fn or kernel_fn")
+        out = jax.vmap(
+            lambda p, isl: _island_block(
+                p, isl, seed, state.step, curmaxsize, fnorm, norm, cfg,
+                eval_fn, stages,
+            )
+        )(pop, jnp.arange(I, dtype=jnp.int32))
+
+    (n_words, n_consts, n_len, n_loss, n_score, n_birth, fd,
+     b_loss, b_w, b_c, b_len) = out
+
+    # unpack back to FlatTrees fields (pointers recomputed from postfix)
+    kind, op, lhs, rhs, feat = unpack_pointers_jnp(
+        n_words.reshape(I * P, N), n_len.reshape(I * P)
+    )
+    reshape = lambda a: a.reshape(I, P, N)
+    state = state._replace(
+        kind=reshape(kind), op=reshape(op), lhs=reshape(lhs),
+        rhs=reshape(rhs), feat=reshape(feat),
+        val=n_consts.astype(jnp.dtype(cfg.val_dtype)),
+        length=n_len, loss=n_loss, score=n_score, birth=n_birth,
+        freq=state.freq + jnp.sum(fd, axis=0),
+        key=key,
+        step=state.step + cfg.ncycles,
+        num_evals=state.num_evals
+        + jnp.float32(cfg.ncycles * I * cfg.events_per_cycle),
+        iteration=state.iteration + 1,
+    )
+
+    # merge the per-island best-seen carries into the global frontier
+    # (per-size min is associative -> same frontier content as per-cycle)
+    bk, bo, bl, br, bf = unpack_pointers_jnp(
+        b_w.reshape(I * S1, N), b_len.reshape(I * S1)
+    )
+    fields = [bk, bo, bl, br, bf, b_c.reshape(I * S1, N).astype(
+        jnp.dtype(cfg.val_dtype)
+    )]
+    losses = b_loss.reshape(I * S1)
+    state = merge_best_seen(
+        state, cfg, losses, jnp.isfinite(losses), fields,
+        b_len.reshape(I * S1),
+    )
+
+    # frequency-window decay (move_window!, window 100k) — same as the
+    # XLA iteration tail
+    total_f = jnp.sum(state.freq)
+    state = state._replace(
+        freq=jnp.where(
+            total_f > 100_000.0, state.freq * (100_000.0 / total_f), state.freq
+        )
+    )
+
+    if cfg.migration:
+        state = _migrate(state, cfg, use_hof=False, norm=norm)
+    if cfg.hof_migration:
+        state = _migrate(state, cfg, use_hof=True, norm=norm)
+    return state

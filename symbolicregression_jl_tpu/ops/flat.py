@@ -25,10 +25,17 @@ __all__ = [
     "KIND_VAR",
     "KIND_UNARY",
     "KIND_BINARY",
+    "PACK_KIND_BITS",
+    "PACK_KIND_MASK",
+    "PACK_PAYLOAD_MAX",
     "FlatTrees",
     "FlatSlab",
+    "PackedPrograms",
     "flatten_trees",
     "unflatten_tree",
+    "pack_programs",
+    "unpack_programs",
+    "pack_words",
     "pad_bucket",
     "bucket_min",
     "bucket_sizes",
@@ -41,6 +48,15 @@ KIND_CONST = 1
 KIND_VAR = 2
 KIND_UNARY = 3
 KIND_BINARY = 4
+
+# Packed device-IR word layout (PackedPrograms): bits 0..2 carry the KIND_*
+# code, bits 3..14 carry the payload — the operator index for UNARY/BINARY
+# slots, the feature index for VAR slots, 0 for CONST/PAD. An int16 word
+# therefore admits payloads up to 4095, far above any realistic operator
+# table or feature count; verify_packed_programs enforces the real bounds.
+PACK_KIND_BITS = 3
+PACK_KIND_MASK = (1 << PACK_KIND_BITS) - 1
+PACK_PAYLOAD_MAX = (1 << (15 - PACK_KIND_BITS)) - 1
 
 
 class FlatTrees(NamedTuple):
@@ -293,6 +309,115 @@ class FlatSlab:
                 return
         for k, t in enumerate(trees):
             self.set_tree(start + k, t)
+
+
+class PackedPrograms(NamedTuple):
+    """Pointerless packed device-IR for a batch of postorder programs.
+
+    This is the kernel-resident form the evolve-block engine mutates in
+    place: one int16 word per slot (kind in the low ``PACK_KIND_BITS`` bits,
+    payload above — see PACK_* constants) plus a separate f32 constants lane.
+    Child pointers are NOT stored: postorder contiguity makes them fully
+    recomputable by a single stack pass (``unpack_programs`` /
+    ``evolve_block._block_pointers``), which is what lets whole subtrees
+    move as contiguous word ranges during mutation with no pointer fixups.
+
+    words:  int16[P, N]  kind | payload << PACK_KIND_BITS
+    consts: float[P, N]  constant value at KIND_CONST slots, exactly 0 elsewhere
+    length: int32[P]     number of live slots; root at length-1
+    """
+
+    words: np.ndarray
+    consts: np.ndarray
+    length: np.ndarray
+
+    @property
+    def n_trees(self) -> int:
+        return self.words.shape[0]
+
+    @property
+    def max_nodes(self) -> int:
+        return self.words.shape[1]
+
+
+def pack_words(kind, op, feat, val, length=None, xp=np):
+    """Elementwise packing shared by the numpy and traced paths: returns
+    ``(words, consts)`` from FlatTrees-style field arrays (lhs/rhs are
+    dropped — they are recomputable). ``xp`` is numpy or jax.numpy; the
+    traced caller passes device arrays and gets a traced pair back.
+
+    Payload slots outside the live range are forced to zero through the
+    kind masks (pad kind is 0 everywhere the state is canonical), so packing
+    a canonical population yields canonical packed programs with zeroed
+    pad words/consts — the invariants verify_packed_programs pins.
+    """
+    kind = xp.asarray(kind)
+    payload = xp.where(
+        (kind == KIND_UNARY) | (kind == KIND_BINARY),
+        xp.asarray(op),
+        xp.where(kind == KIND_VAR, xp.asarray(feat), 0),
+    )
+    words = (kind | (payload << PACK_KIND_BITS)).astype(xp.int16)
+    consts = xp.where(kind == KIND_CONST, xp.asarray(val), 0).astype(
+        xp.asarray(val).dtype
+    )
+    return words, consts
+
+
+def pack_programs(flat: FlatTrees) -> PackedPrograms:
+    """Pack a FlatTrees batch into the pointerless device IR (numpy)."""
+    words, consts = pack_words(
+        np.asarray(flat.kind), np.asarray(flat.op), np.asarray(flat.feat),
+        np.asarray(flat.val), xp=np,
+    )
+    return PackedPrograms(words, consts, np.asarray(flat.length, np.int32))
+
+
+def unpack_programs(packed: PackedPrograms, dtype=None) -> FlatTrees:
+    """Exact round-trip of ``pack_programs``: rebuild the FlatTrees batch,
+    reconstructing lhs/rhs child pointers with a postfix stack pass (numpy).
+
+    Raises ValueError on stack-discipline violations (a malformed packed
+    row cannot silently produce a plausible tree)."""
+    words = np.asarray(packed.words)
+    consts = np.asarray(packed.consts)
+    length = np.asarray(packed.length, np.int32)
+    P, N = words.shape
+    w32 = words.astype(np.int32)
+    kind = (w32 & PACK_KIND_MASK).astype(np.int32)
+    payload = (w32 >> PACK_KIND_BITS).astype(np.int32)
+
+    op = np.where(
+        (kind == KIND_UNARY) | (kind == KIND_BINARY), payload, 0
+    ).astype(np.int32)
+    feat = np.where(kind == KIND_VAR, payload, 0).astype(np.int32)
+    val = np.where(kind == KIND_CONST, consts, 0).astype(
+        consts.dtype if dtype is None else dtype
+    )
+    lhs = np.zeros((P, N), np.int32)
+    rhs = np.zeros((P, N), np.int32)
+
+    for p in range(P):
+        stack: list[int] = []
+        for i in range(int(length[p])):
+            k = kind[p, i]
+            if k == KIND_UNARY:
+                if len(stack) < 1:
+                    raise ValueError(f"row {p}: unary at slot {i} underflows")
+                lhs[p, i] = stack.pop()
+            elif k == KIND_BINARY:
+                if len(stack) < 2:
+                    raise ValueError(f"row {p}: binary at slot {i} underflows")
+                rhs[p, i] = stack.pop()
+                lhs[p, i] = stack.pop()
+            elif k == KIND_PAD:
+                raise ValueError(f"row {p}: pad slot {i} inside live range")
+            stack.append(i)
+        if int(length[p]) and len(stack) != 1:
+            raise ValueError(
+                f"row {p}: {len(stack)} roots after postfix pass (want 1)"
+            )
+    return FlatTrees(kind, op, lhs, rhs, feat, val, length)
 
 
 def unflatten_tree(flat: FlatTrees, p: int) -> Node:

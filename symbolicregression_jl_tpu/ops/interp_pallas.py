@@ -1093,3 +1093,330 @@ def make_pallas_diff_loss_fn(X, y, weights, opset: OperatorSet, loss_elem):
         )
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Kernel-resident evolution block (r17): one Pallas program per island runs
+# a WHOLE ncycles evolution block — tournament, mutation on packed int16
+# words, constraint checks, loss scoring, annealing-gated accept — with the
+# population resident in VMEM. The cycle driver is ops/evolve_block.
+# _block_cycle, the SAME values-based code the XLA reference executes; only
+# the evaluator differs, and it clones the loss kernel's scratch-slot loop
+# (per-tree (8, C) tiles, pl.when predicated opcode writes), so interpret-
+# mode losses match the reference at f32 tolerance.
+#
+# The block kernel requires the single-tile row layout (R <= 8 * C_TILE):
+# one (8, C) resident tile means scoring needs no cross-tile accumulator
+# in the cycle loop. models/device_search gates on that before choosing it.
+# ---------------------------------------------------------------------------
+
+
+def _make_evolve_block_kernel(opset, loss_elem, cfg, C, R, stages):
+    from .evolve_block import _block_cycle, _block_pointers
+
+    from .flat import PACK_KIND_BITS, PACK_KIND_MASK
+
+    unary_fns = [op.kernel_fn or op.fn for op in opset.unary]
+    binary_fns = [op.kernel_fn or op.fn for op in opset.binary]
+    N, P, E, S1 = cfg.n_slots, cfg.pop_size, cfg.events_per_cycle, cfg.maxsize + 1
+
+    def kernel(
+        words_ref, consts_ref, len_ref, loss_ref, score_ref, birth_ref,
+        fnorm_ref, x_ref, y_ref, w_ref, iscal_ref, fscal_ref,
+        w_out, c_out, l_out, lo_out, sc_out, b_out,
+        fd_out, bsl_out, bsw_out, bsc_out, bslen_out,
+        buf_ref,
+    ):
+        isl = pl.program_id(0)
+        seed = iscal_ref[0, 0].astype(jnp.uint32)
+        step0 = iscal_ref[0, 1]
+        curmaxsize = iscal_ref[0, 2]
+        norm = fscal_ref[0, 0]
+
+        yv = y_ref[...]
+        wv = w_ref[...]
+        sub = lax.broadcasted_iota(jnp.int32, (8, C), 0)
+        colr = lax.broadcasted_iota(jnp.int32, (8, C), 1)
+        mask = sub * C + colr < R
+        wsum = jnp.sum(jnp.where(mask, wv, 0.0))
+        iota_e = lax.broadcasted_iota(jnp.int32, (E,), 0)
+        iota_n = lax.broadcasted_iota(jnp.int32, (N,), 0)
+
+        def eval_fn(vw, vc, vlen):
+            """Score E candidate programs sequentially against the resident
+            row tile — the loss kernel's tree/slot loop, reading program
+            structure from VALUES via one-hot scalar extraction."""
+            lhs, rhs, _s, _d = _block_pointers(vw, vlen)
+
+            def tree_body(e, losses):
+                sel_e = iota_e == e
+                row_w = jnp.sum(jnp.where(sel_e[:, None], vw, 0), axis=0)
+                row_c = jnp.sum(jnp.where(sel_e[:, None], vc, 0.0), axis=0)
+                row_l = jnp.sum(jnp.where(sel_e[:, None], lhs, 0), axis=0)
+                row_r = jnp.sum(jnp.where(sel_e[:, None], rhs, 0), axis=0)
+                tlen = jnp.sum(jnp.where(sel_e, vlen, 0))
+
+                def slot_body(i, _):
+                    sel_i = iota_n == i
+                    wsc = jnp.sum(jnp.where(sel_i, row_w, 0))
+                    kindc = wsc & PACK_KIND_MASK
+                    payload = wsc >> PACK_KIND_BITS
+                    cval = jnp.sum(jnp.where(sel_i, row_c, 0.0))
+                    li = jnp.sum(jnp.where(sel_i, row_l, 0))
+                    ri = jnp.sum(jnp.where(sel_i, row_r, 0))
+                    i8 = pl.multiple_of(i * 8, 8)
+
+                    @pl.when(kindc == KIND_CONST)
+                    def _const():
+                        buf_ref[pl.ds(i8, 8), :] = jnp.full(
+                            (8, C), cval, dtype=jnp.float32
+                        )
+
+                    @pl.when(kindc == KIND_VAR)
+                    def _var():
+                        f8 = pl.multiple_of(payload * 8, 8)
+                        buf_ref[pl.ds(i8, 8), :] = x_ref[pl.ds(f8, 8), :]
+
+                    for k, fn in enumerate(unary_fns):
+
+                        @pl.when((kindc == KIND_UNARY) & (payload == k))
+                        def _una(fn=fn):
+                            l8 = pl.multiple_of(li * 8, 8)
+                            buf_ref[pl.ds(i8, 8), :] = fn(
+                                buf_ref[pl.ds(l8, 8), :]
+                            )
+
+                    for k, fn in enumerate(binary_fns):
+
+                        @pl.when((kindc == KIND_BINARY) & (payload == k))
+                        def _bin(fn=fn):
+                            l8 = pl.multiple_of(li * 8, 8)
+                            r8 = pl.multiple_of(ri * 8, 8)
+                            buf_ref[pl.ds(i8, 8), :] = fn(
+                                buf_ref[pl.ds(l8, 8), :],
+                                buf_ref[pl.ds(r8, 8), :],
+                            )
+
+                    return 0
+
+                lax.fori_loop(0, tlen, slot_body, 0)
+                root8 = pl.multiple_of((tlen - 1) * 8, 8)
+                pred = buf_ref[pl.ds(root8, 8), :]
+                elem = loss_elem(pred, yv)
+                loss_part = jnp.sum(jnp.where(mask, elem * wv, 0.0))
+                nonfin = jnp.sum(
+                    jnp.where(mask & ~jnp.isfinite(pred), 1.0, 0.0)
+                )
+                l_e = jnp.where(
+                    (nonfin == 0) & (wsum > 0),
+                    loss_part / jnp.maximum(wsum, 1e-30),
+                    jnp.inf,
+                )
+                return jnp.where(sel_e, l_e, losses)
+
+            return lax.fori_loop(
+                0, E, tree_body, jnp.full((E,), jnp.inf, jnp.float32)
+            )
+
+        carry0 = (
+            words_ref[0], consts_ref[0], len_ref[0], loss_ref[0],
+            score_ref[0], birth_ref[0],
+            jnp.zeros((S1,), jnp.float32),
+            jnp.full((S1,), jnp.inf, jnp.float32),
+            jnp.zeros((S1, N), jnp.int32),
+            jnp.zeros((S1, N), jnp.float32),
+            jnp.zeros((S1,), jnp.int32),
+        )
+
+        def body(cycle, carry):
+            return _block_cycle(
+                carry, cycle.astype(jnp.int32), isl, seed, step0, curmaxsize,
+                fnorm_ref[0], norm, cfg, eval_fn, stages,
+            )
+
+        out = lax.fori_loop(0, cfg.ncycles, body, carry0)
+        w_out[...] = out[0][None]
+        c_out[...] = out[1][None]
+        l_out[...] = out[2][None]
+        lo_out[...] = out[3][None]
+        sc_out[...] = out[4][None]
+        b_out[...] = out[5][None]
+        fd_out[...] = out[6][None]
+        bsl_out[...] = out[7][None]
+        bsw_out[...] = out[8][None]
+        bsc_out[...] = out[9][None]
+        bslen_out[...] = out[10][None]
+
+    kernel.__name__ = (
+        f"sr_evoblk_n{N}_p{P}_e{E}_cy{cfg.ncycles}_c{C}_R{R}_s{stages}"
+        f"_h{hash(cfg) & 0xFFFFFFFF:x}_o{hash(opset) & 0xFFFFFFFF:x}"
+        f"_l{_loss_uid(loss_elem)}"
+    )
+    return kernel
+
+
+def make_evolve_block_fn(Xr, yr, wr, R, opset, loss_elem, cfg, stages=4,
+                         interpret=None):
+    """Build kernel_fn for evolve_block.run_block_iteration's kernel path.
+
+    ``Xr``/``yr``/``wr``: single-tile packed rows ((F*8, C), (8, C), (8, C)
+    with C == C_TILE) — callers gate on R <= 8 * C_TILE. Returns
+    kernel_fn(words, consts, length, loss, score, birth, fnorm, seed,
+    step0, curmaxsize, norm) -> the 11-tuple block carry, stacked [I, ...].
+    """
+    if interpret is None:
+        interpret = pallas_interpret_enabled()
+    F8, C = Xr.shape
+    I, P, N = cfg.n_islands, cfg.pop_size, cfg.n_slots
+    S1 = cfg.maxsize + 1
+    kernel = _make_evolve_block_kernel(opset, loss_elem, cfg, C, R, stages)
+    if interpret:
+        kernel.__name__ += "_interp"
+
+    isl_pn = pl.BlockSpec((1, P, N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    isl_p = pl.BlockSpec((1, P), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    fixed = lambda shape: pl.BlockSpec(
+        shape, lambda i: (0,) * len(shape), memory_space=pltpu.VMEM
+    )
+    out_pn = pl.BlockSpec((1, P, N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+    out_p = pl.BlockSpec((1, P), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    out_s = pl.BlockSpec((1, S1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    out_sn = pl.BlockSpec((1, S1, N), lambda i: (i, 0, 0), memory_space=pltpu.VMEM)
+
+    call = pl.pallas_call(
+        kernel,
+        grid=(I,),
+        in_specs=[
+            isl_pn,  # words
+            isl_pn,  # consts
+            isl_p,   # length
+            isl_p,   # loss
+            isl_p,   # score
+            isl_p,   # birth
+            fixed((1, S1)),   # fnorm snapshot
+            fixed((F8, C)),   # Xr
+            fixed((8, C)),    # yr
+            fixed((8, C)),    # wr
+            pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 2), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((I, P, N), jnp.int32),
+            jax.ShapeDtypeStruct((I, P, N), jnp.float32),
+            jax.ShapeDtypeStruct((I, P), jnp.int32),
+            jax.ShapeDtypeStruct((I, P), jnp.float32),
+            jax.ShapeDtypeStruct((I, P), jnp.float32),
+            jax.ShapeDtypeStruct((I, P), jnp.int32),
+            jax.ShapeDtypeStruct((I, S1), jnp.float32),
+            jax.ShapeDtypeStruct((I, S1), jnp.float32),
+            jax.ShapeDtypeStruct((I, S1, N), jnp.int32),
+            jax.ShapeDtypeStruct((I, S1, N), jnp.float32),
+            jax.ShapeDtypeStruct((I, S1), jnp.int32),
+        ],
+        out_specs=[
+            out_pn, out_pn, out_p, out_p, out_p, out_p,
+            out_s, out_s, out_sn, out_sn, out_s,
+        ],
+        scratch_shapes=[pltpu.VMEM((N * 8, C), jnp.float32)],
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )
+
+    def kernel_fn(words, consts, length, loss, score, birth, fnorm, seed,
+                  step0, curmaxsize, norm):
+        iscal = jnp.stack(
+            [
+                seed.astype(jnp.int32),
+                jnp.asarray(step0, jnp.int32),
+                jnp.asarray(curmaxsize, jnp.int32),
+                jnp.int32(0),
+            ]
+        )[None]
+        fscal = jnp.stack(
+            [jnp.asarray(norm, jnp.float32), jnp.float32(0.0)]
+        )[None]
+        return tuple(
+            call(
+                words, consts, length, loss, score, birth,
+                fnorm.reshape(1, S1).astype(jnp.float32),
+                Xr, yr, wr, iscal, fscal,
+            )
+        )
+
+    return kernel_fn
+
+
+_EVOBLK_SUPPORT_CACHE: dict = {}
+
+
+def evolve_block_supported(opset, n_features: int = 2, loss_elem=None) -> bool:
+    """Probe whether the evolve-block kernel lowers through Mosaic — by
+    COMPILING AND RUNNING a miniature block, like pallas_supported. The
+    block leans on far more of Mosaic than the loss kernel (1-D iotas,
+    [E, N, N] one-hot permutes, uint32 hashing), so a dedicated probe gates
+    it independently: lowering failures here auto-fall back to the XLA
+    reference backend, never to a crash. Cached per (opset, loss,
+    interpret)."""
+    from .losses import L2DistLoss
+
+    loss_elem = loss_elem or L2DistLoss
+    interpret = pallas_interpret_enabled()
+    if jax.devices()[0].platform == "cpu" and not interpret:
+        return False
+    key = (opset, loss_elem, interpret)
+    if key in _EVOBLK_SUPPORT_CACHE:
+        return _EVOBLK_SUPPORT_CACHE[key]
+    try:
+        from .evolve import EvoConfig
+
+        nf = max(n_features, 1)
+        cfg = EvoConfig(
+            n_islands=1, pop_size=8, n_slots=8, maxsize=7, maxdepth=6,
+            nfeatures=nf, n_unary=opset.n_unary, n_binary=opset.n_binary,
+            tournament_n=2, tournament_weights=(0.8, 0.2),
+            mutation_weights=(0.2, 0.2, 0.1, 0.2, 0.1, 0.1, 0.0, 0.1),
+            crossover_probability=0.0, annealing=True, alpha=0.1,
+            parsimony=0.0, use_frequency=True,
+            use_frequency_in_tournament=True,
+            adaptive_parsimony_scaling=20.0, perturbation_factor=0.076,
+            probability_negate_constant=0.01, baseline_loss=1.0,
+            use_baseline=True, ncycles=2, events_per_cycle=2,
+            fraction_replaced=0.0, fraction_replaced_hof=0.0,
+            migration=False, hof_migration=False, topn=4, niterations=1,
+            warmup_maxsize_by=0.0,
+        )
+        X = np.ones((nf, 64), np.float32)
+        y = np.ones((64,), np.float32)
+        Xr, yr, wr, _C, R = _reshape_rows(X, y, None)
+        fn = make_evolve_block_fn(Xr, yr, wr, R, opset, loss_elem, cfg)
+        P, N, S1 = cfg.pop_size, cfg.n_slots, cfg.maxsize + 1
+        words = jnp.full((1, P, N), 0, jnp.int32).at[:, :, 0].set(
+            2 | (0 << 3)  # KIND_VAR feature 0
+        )
+        out = fn(
+            words,
+            jnp.zeros((1, P, N), jnp.float32),
+            jnp.ones((1, P), jnp.int32),
+            jnp.ones((1, P), jnp.float32),
+            jnp.ones((1, P), jnp.float32),
+            jnp.zeros((1, P), jnp.int32),
+            jnp.ones((S1,), jnp.float32) / S1,
+            jnp.uint32(42),
+            jnp.asarray(P, jnp.int32),
+            jnp.asarray(cfg.maxsize, jnp.int32),
+            jnp.float32(1.0),
+        )
+        jax.block_until_ready(out)
+        _EVOBLK_SUPPORT_CACHE[key] = True  # srl: disable=SRL009 -- boolean Mosaic-probe memo, not a program store
+    except Exception as e:  # noqa: BLE001 — any lowering failure means fallback
+        import warnings
+
+        warnings.warn(
+            f"evolve-block kernel unavailable for {opset}: "
+            f"{type(e).__name__}: {e}"
+        )
+        _EVOBLK_SUPPORT_CACHE[key] = False  # srl: disable=SRL009 -- boolean Mosaic-probe memo, not a program store
+    return _EVOBLK_SUPPORT_CACHE[key]
+
+
+__all__ += ["make_evolve_block_fn", "evolve_block_supported"]

@@ -33,6 +33,15 @@ Builds must happen OUTSIDE the lock (an engine compile is tens of seconds —
 holding the lock would serialize every concurrent tenant): ``get`` then
 build then ``put``, where ``put`` has setdefault semantics and returns the
 winning value, so racing builders converge on one canonical executable.
+
+r17 adds the kernel-resident evolution block to the keyed artifact classes:
+``"block_fn"`` entries memoize the identity-stable block closures (they are
+jit STATIC arguments, so identity IS the jit/AOT cache key), the ``"aot"``
+and ``"fleet_aot"`` ``k_fused`` tuples carry a ``("blk", backend, n_rows)``
+token whenever SR_ENGINE_BLOCK replaced the evolve leg (the backend choice
+and resident row count are baked into the fused executable), and
+``"score_data"`` keys carry ``need_packed`` (the block's XLA reference
+backend consumes the packed Xr/yr/wr rows even on non-Pallas platforms).
 """
 
 from __future__ import annotations

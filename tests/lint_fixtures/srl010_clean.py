@@ -1,0 +1,30 @@
+"""SRL010 clean twin: pack once outside the loop, keep programs
+device-resident inside it (in-graph pack_words is the r17 contract), and
+one-shot packs in non-hot functions stay allowed."""
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu.ops.flat import pack_words
+from symbolicregression_jl_tpu.ops.interp_pallas import pack_flat_fused
+from symbolicregression_jl_tpu.ops.scoring import pack_flat
+
+
+def device_search_one_output(flat, state, opset, score_fn, niterations):
+    # packed ONCE at build time; the loop only dispatches compiled programs
+    ints, vals = pack_flat_fused(flat, opset)
+    total = 0.0
+    for it in range(niterations):
+        total += float(score_fn(ints, vals)[0])
+        # in-graph packing is device-resident — no host round-trip
+        words, consts = pack_words(
+            state.kind, state.op, state.feat, state.val, xp=jnp
+        )
+        total += float(words.sum())
+    return total
+
+
+def cold_helper(flat, opset):
+    # not a hot-path function: one-shot packs are fine even in loops
+    out = []
+    for _ in range(2):
+        out.append(pack_flat(flat, opset))
+    return out

@@ -1,0 +1,18 @@
+"""SRL010 violation: host program-IR packing inside an engine hot loop.
+
+``pack_flat`` / ``pack_flat_fused`` pull the candidate batch back to the
+host and re-upload the packed arrays — per cycle, that is the exact HBM
+round-trip the r17 kernel-resident evolve block removes.
+"""
+from symbolicregression_jl_tpu.ops.interp_pallas import pack_flat_fused
+from symbolicregression_jl_tpu.ops.scoring import pack_flat
+
+
+def device_search_one_output(flat, opset, score_fn, niterations):
+    total = 0.0
+    for it in range(niterations):
+        ints = pack_flat(flat, opset)  # EXPECT: SRL010
+        total += float(score_fn(ints)[0])
+        ints2, vals2 = pack_flat_fused(flat, opset)  # EXPECT: SRL010
+        total += float(score_fn(ints2)[0]) + float(vals2[0, 0])
+    return total

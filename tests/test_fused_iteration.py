@@ -121,3 +121,93 @@ def test_device_search_uses_unified_program_cache():
     cache.put("score_fn", "new", object())  # at cap: evicts LRU = k1
     assert cache.get("score_fn", "k0") == 0
     assert cache.get("score_fn", "k1") is None
+
+
+# -- r17 kernel-resident evolution block (SR_ENGINE_BLOCK) -------------------
+
+
+def _block_opts(**kw):
+    # small enough that the CPU reference backend stays fast in tier-1
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=10,
+        maxsize=13,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def test_engine_block_off_is_bit_identical(monkeypatch):
+    """SR_ENGINE_BLOCK=0 must be a no-op: bit-identical frontier to a run
+    with the variable unset (the pre-r17 fused path). This pins the
+    opt-in contract — the packed-mutation divergence never leaks into the
+    default trajectory."""
+    X, y = _problem()
+    monkeypatch.delenv("SR_ENGINE_BLOCK", raising=False)
+    r_default = equation_search(
+        X, y, options=_block_opts(), niterations=3, verbosity=0
+    )
+    monkeypatch.setenv("SR_ENGINE_BLOCK", "0")
+    r_off = equation_search(
+        X, y, options=_block_opts(), niterations=3, verbosity=0
+    )
+    assert _frontier(r_off) == _frontier(r_default)
+    assert r_off.best().tree.same_structure(r_default.best().tree)
+
+
+def test_engine_block_deterministic(monkeypatch):
+    """The block's counter-derived RNG makes SR_ENGINE_BLOCK=1 reproducible:
+    same seed, two fresh searches, bit-identical frontier."""
+    monkeypatch.setenv("SR_ENGINE_BLOCK", "1")
+    X, y = _problem()
+    r1 = equation_search(X, y, options=_block_opts(), niterations=2, verbosity=0)
+    r2 = equation_search(X, y, options=_block_opts(), niterations=2, verbosity=0)
+    assert _frontier(r1) == _frontier(r2)
+
+
+def test_engine_block_dispatch_count(monkeypatch):
+    """SR_ENGINE_BLOCK=1 keeps the fused path's <=2-dispatch invariant: the
+    block rides INSIDE the fused megaprogram (one dispatch) plus the packed
+    readback — nothing else."""
+    monkeypatch.setenv("SR_ENGINE_BLOCK", "1")
+    calls = []
+    monkeypatch.setattr(ds, "_DISPATCH_HOOK", calls.append)
+    X, y = _problem()
+    equation_search(X, y, options=_block_opts(), niterations=3, verbosity=0)
+    counts = {name: calls.count(name) for name in set(calls)}
+    assert set(counts) == {"fused_iter", "readback"}, counts
+    assert counts["fused_iter"] == 3
+    assert counts["readback"] == 3
+
+
+def test_engine_block_fleet_dispatch_count(monkeypatch):
+    """Fleet-stacked SR_ENGINE_BLOCK=1: N lanes vmapped through the block
+    still cost <=2 device dispatches per iteration."""
+    from symbolicregression_jl_tpu.models.device_search import (
+        FleetLaneSpec,
+        fleet_search,
+    )
+
+    monkeypatch.setenv("SR_ENGINE_BLOCK", "1")
+    calls = []
+    monkeypatch.setattr(ds, "_DISPATCH_HOOK", calls.append)
+    X, y = _problem()
+    specs = [
+        FleetLaneSpec(
+            X=X, y=y, options=_block_opts(seed=s), niterations=2,
+            label=f"lane{s}",
+        )
+        for s in (0, 1)
+    ]
+    results = fleet_search(specs, verbosity=0)
+    assert len(results) == 2
+    counts = {name: calls.count(name) for name in set(calls)}
+    assert set(counts) <= {"fused_iter", "readback"}, counts
+    assert counts["fused_iter"] == 2
+    assert counts["readback"] == 2

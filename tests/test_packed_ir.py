@@ -1,0 +1,166 @@
+"""Packed device-IR (r17): the pointerless int16 word + f32 constants form
+the kernel-resident evolve block mutates in place.
+
+Pinned here: exact FlatTrees round-trip (child pointers recomputed by the
+postfix stack pass), bitfield layout invariants, verify_packed_programs
+rejecting every malformation class, and the traced (jnp) pack_words path
+agreeing bit-for-bit with the numpy one.
+"""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.analysis.ir_verify import (
+    verify_packed_programs,
+)
+from symbolicregression_jl_tpu.models.population import Population
+from symbolicregression_jl_tpu.ops import flatten_trees
+from symbolicregression_jl_tpu.ops.flat import (
+    KIND_BINARY,
+    KIND_CONST,
+    KIND_PAD,
+    KIND_UNARY,
+    KIND_VAR,
+    PACK_KIND_BITS,
+    PACK_KIND_MASK,
+    pack_programs,
+    pack_words,
+    unpack_programs,
+)
+
+OPTS = Options(
+    binary_operators=["+", "-", "*", "/"],
+    unary_operators=["cos", "exp", "abs"],
+    maxsize=20,
+    save_to_file=False,
+)
+N = OPTS.max_nodes
+
+
+def _corpus(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    trees = Population.random_trees(n, OPTS, 5, rng)
+    return flatten_trees(trees, N)
+
+
+def test_round_trip_exact():
+    flat = _corpus()
+    packed = pack_programs(flat)
+    back = unpack_programs(packed)
+    np.testing.assert_array_equal(back.kind, np.asarray(flat.kind))
+    np.testing.assert_array_equal(back.op, np.asarray(flat.op))
+    np.testing.assert_array_equal(back.feat, np.asarray(flat.feat))
+    np.testing.assert_array_equal(back.length, np.asarray(flat.length))
+    np.testing.assert_array_equal(back.val, np.asarray(flat.val))
+    # child pointers are NOT stored — the stack pass must recompute the
+    # originals exactly on every live slot
+    live = np.arange(N)[None, :] < np.asarray(flat.length)[:, None]
+    np.testing.assert_array_equal(
+        np.where(live, back.lhs, 0), np.where(live, np.asarray(flat.lhs), 0)
+    )
+    np.testing.assert_array_equal(
+        np.where(live, back.rhs, 0), np.where(live, np.asarray(flat.rhs), 0)
+    )
+
+
+def test_word_layout():
+    """kind lives in the low PACK_KIND_BITS bits, payload above; pad slots
+    are all-zero words with zero consts."""
+    flat = _corpus(16, seed=1)
+    packed = pack_programs(flat)
+    words = packed.words.astype(np.int32) & 0xFFFF
+    assert packed.words.dtype == np.int16
+    assert packed.consts.dtype == np.float32
+    kind = words & PACK_KIND_MASK
+    payload = words >> PACK_KIND_BITS
+    np.testing.assert_array_equal(kind, np.asarray(flat.kind))
+    live = np.arange(N)[None, :] < np.asarray(flat.length)[:, None]
+    np.testing.assert_array_equal(words[~live], 0)
+    np.testing.assert_array_equal(packed.consts[~live], 0.0)
+    is_un = kind == KIND_UNARY
+    is_bin = kind == KIND_BINARY
+    np.testing.assert_array_equal(
+        payload[is_un | is_bin], np.asarray(flat.op)[is_un | is_bin]
+    )
+    is_var = kind == KIND_VAR
+    np.testing.assert_array_equal(
+        payload[is_var], np.asarray(flat.feat)[is_var]
+    )
+    # consts lane: values exactly where KIND_CONST, zero elsewhere
+    is_const = kind == KIND_CONST
+    np.testing.assert_array_equal(
+        packed.consts[is_const], np.asarray(flat.val, np.float32)[is_const]
+    )
+    np.testing.assert_array_equal(packed.consts[~is_const], 0.0)
+
+
+def test_pack_words_traced_matches_numpy():
+    import jax.numpy as jnp
+
+    flat = _corpus(16, seed=2)
+    w_np, c_np = pack_words(
+        np.asarray(flat.kind), np.asarray(flat.op), np.asarray(flat.feat),
+        np.asarray(flat.val), xp=np,
+    )
+    w_j, c_j = pack_words(
+        jnp.asarray(flat.kind), jnp.asarray(flat.op),
+        jnp.asarray(flat.feat), jnp.asarray(flat.val), xp=jnp,
+    )
+    np.testing.assert_array_equal(np.asarray(w_j, np.int16), w_np)
+    np.testing.assert_array_equal(np.asarray(c_j), c_np)
+
+
+def test_verify_accepts_corpus():
+    packed = pack_programs(_corpus())
+    verify_packed_programs(packed, OPTS.operators, n_features=5)
+
+
+def _one(kind_seq, consts=None):
+    """Single-program PackedPrograms from (kind, payload) tuples."""
+    words = np.zeros((1, N), np.int16)
+    cl = np.zeros((1, N), np.float32)
+    for i, (k, p) in enumerate(kind_seq):
+        words[0, i] = np.int16(k | (p << PACK_KIND_BITS))
+        if consts is not None and k == KIND_CONST:
+            cl[0, i] = consts
+    length = np.asarray([len(kind_seq)], np.int32)
+    from symbolicregression_jl_tpu.ops.flat import PackedPrograms
+
+    return PackedPrograms(words, cl, length)
+
+
+def test_verify_rejects_malformed():
+    ops = OPTS.operators
+    # binary op at slot 0: stack underflow
+    with pytest.raises(ValueError, match="stack"):
+        verify_packed_programs(_one([(KIND_BINARY, 0)]), ops, n_features=5)
+    # two pushes, no combine: root does not consume the stack
+    with pytest.raises(ValueError, match="stack"):
+        verify_packed_programs(
+            _one([(KIND_VAR, 0), (KIND_VAR, 1)]), ops, n_features=5
+        )
+    # pad word inside the live range
+    with pytest.raises(ValueError, match="pad|kind"):
+        verify_packed_programs(
+            _one([(KIND_VAR, 0), (KIND_PAD, 0), (KIND_BINARY, 0)]),
+            ops, n_features=5,
+        )
+    # operator index out of range for the opset
+    with pytest.raises(ValueError, match="op"):
+        verify_packed_programs(
+            _one([(KIND_VAR, 0), (KIND_UNARY, 11)]), ops, n_features=5
+        )
+    # feature index out of range
+    with pytest.raises(ValueError, match="feat"):
+        verify_packed_programs(_one([(KIND_VAR, 9)]), ops, n_features=5)
+    # nonzero garbage in the pad tail of the consts lane
+    bad = _one([(KIND_VAR, 0)])
+    bad.consts[0, 5] = 1.0
+    with pytest.raises(ValueError, match="const|pad"):
+        verify_packed_programs(bad, ops, n_features=5)
+
+
+def test_unpack_rejects_malformed():
+    with pytest.raises(ValueError):
+        unpack_programs(_one([(KIND_BINARY, 0)]))

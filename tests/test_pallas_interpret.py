@@ -286,3 +286,183 @@ def test_engine_interpret_matches_scan_engine(monkeypatch):
         [m.loss for m in r_scan.pareto_frontier],
         rtol=1e-6,
     )
+
+
+# -- r17 kernel-resident evolution block -------------------------------------
+
+
+def _block_cfg(ncycles=3):
+    from symbolicregression_jl_tpu.ops.evolve import EvoConfig
+
+    return EvoConfig(
+        n_islands=2, pop_size=8, n_slots=16, maxsize=13, maxdepth=8,
+        nfeatures=2, n_unary=1, n_binary=3, tournament_n=2,
+        tournament_weights=(0.8, 0.2),
+        mutation_weights=(0.2, 0.2, 0.1, 0.2, 0.1, 0.1, 0.05, 0.05),
+        crossover_probability=0.0, annealing=True, alpha=0.1,
+        parsimony=0.0032, use_frequency=True,
+        use_frequency_in_tournament=True, adaptive_parsimony_scaling=20.0,
+        perturbation_factor=0.076, probability_negate_constant=0.01,
+        baseline_loss=1.0, use_baseline=True, ncycles=ncycles,
+        events_per_cycle=4, fraction_replaced=0.0, fraction_replaced_hof=0.0,
+        migration=False, hof_migration=False, topn=12, niterations=4,
+        warmup_maxsize_by=0.0,
+    )
+
+
+def _block_state(cfg):
+    from symbolicregression_jl_tpu.ops.evolve import init_state
+    from symbolicregression_jl_tpu.ops.flat import (
+        KIND_BINARY,
+        KIND_CONST,
+        KIND_UNARY,
+        KIND_VAR,
+    )
+    from symbolicregression_jl_tpu.ops.flat import FlatTrees
+
+    B, N = cfg.n_islands * cfg.pop_size, cfg.n_slots
+    kind = np.zeros((B, N), np.int32)
+    op = np.zeros_like(kind)
+    lhs = np.zeros_like(kind)
+    rhs = np.zeros_like(kind)
+    feat = np.zeros_like(kind)
+    val = np.zeros((B, N), np.float32)
+    length = np.zeros((B,), np.int32)
+    # a seed mix of leaves, a binary, and a unary so every mutation kind
+    # has structure to act on from cycle 0
+    for t in range(B):
+        m = t % 4
+        if m == 0:
+            kind[t, 0] = KIND_VAR
+            length[t] = 1
+        elif m == 1:
+            kind[t, 0] = KIND_CONST
+            val[t, 0] = 1.5
+            length[t] = 1
+        elif m == 2:
+            kind[t, 0] = KIND_VAR
+            kind[t, 1] = KIND_VAR
+            feat[t, 1] = 1
+            kind[t, 2] = KIND_BINARY
+            lhs[t, 2] = 0
+            rhs[t, 2] = 1
+            length[t] = 3
+        else:
+            kind[t, 0] = KIND_VAR
+            kind[t, 1] = KIND_UNARY
+            lhs[t, 1] = 0
+            length[t] = 2
+    flat = FlatTrees(kind, op, lhs, rhs, feat, val, length)
+    return init_state(flat, np.ones(B), cfg, seed=0)
+
+
+def test_evolve_block_supported_under_interpret():
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        evolve_block_supported,
+    )
+    from symbolicregression_jl_tpu.ops.operators import resolve_operators
+
+    opset = resolve_operators(["+", "-", "*"], ["cos"])
+    assert evolve_block_supported(opset, 2)
+
+
+def test_evolve_block_kernel_matches_reference():
+    """The emulated evolve-block kernel must reproduce the vmapped XLA
+    reference backend EXACTLY on every EvoState field: both backends run the
+    identical _block_cycle trajectory (same counter-derived RNG), so every
+    mutation/accept decision is bitwise and only the loss reduction could
+    differ (same 8-sublane tile order on both sides -> observed exact;
+    asserted at f32 tolerance for the float fields)."""
+    from symbolicregression_jl_tpu.ops import evolve_block as eb
+    from symbolicregression_jl_tpu.ops.interp_pallas import (
+        _reshape_rows,
+        make_evolve_block_fn,
+    )
+    from symbolicregression_jl_tpu.ops.operators import resolve_operators
+
+    cfg = _block_cfg()
+    state = _block_state(cfg)
+    opset = resolve_operators(["+", "-", "*"], ["cos"])
+    rng = np.random.default_rng(0)
+    R = 100
+    X = rng.normal(size=(2, R)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    Xr, yr, wr, _, _ = _reshape_rows(X, y, None)
+
+    def loss_elem(pred, yv):
+        d = pred - yv
+        return d * d
+
+    class Data:
+        norm = jnp.float32(1.0)
+
+    eval_fn = eb.make_reference_eval(opset, loss_elem, Xr, yr, wr, R)
+    kfn = make_evolve_block_fn(
+        Xr, yr, wr, R, opset, loss_elem, cfg, interpret=True
+    )
+    st_ref = jax.jit(
+        lambda st: eb.run_block_iteration(st, Data(), cfg, eval_fn=eval_fn)
+    )(state)
+    st_ker = jax.jit(
+        lambda st: eb.run_block_iteration(st, Data(), cfg, kernel_fn=kfn)
+    )(state)
+    for name in type(st_ref)._fields:
+        ref_leaves = jax.tree_util.tree_leaves(getattr(st_ref, name))
+        ker_leaves = jax.tree_util.tree_leaves(getattr(st_ker, name))
+        for a, b in zip(ref_leaves, ker_leaves):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.dtype.kind == "f":
+                # inf (unscored best-seen slots) must match positionally
+                np.testing.assert_array_equal(
+                    np.isfinite(a), np.isfinite(b), err_msg=name
+                )
+                fin = np.isfinite(a) & np.isfinite(b)
+                np.testing.assert_allclose(
+                    a[fin], b[fin], rtol=1e-6, atol=1e-7, err_msg=name
+                )
+            else:
+                np.testing.assert_array_equal(a, b, err_msg=name)
+
+
+def test_engine_block_kernel_matches_reference_backend(monkeypatch):
+    """End-to-end driver parity between the two SR_ENGINE_BLOCK=1 backends,
+    everything else held fixed (both legs under interpret, so initial
+    scoring and const-opt compile the identical programs): the kernel leg
+    runs the emulated evolve-block grid; the second leg is pinned to the
+    vmapped XLA reference backend by patching evolve_block_supported. Same
+    seed, same _block_cycle trajectory -> same frontier (losses at
+    reduction-order tolerance, like the scan-engine test above)."""
+    from symbolicregression_jl_tpu.ops import interp_pallas
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(2, 100)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    opts = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=8,
+        maxsize=13,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+    monkeypatch.setenv("SR_ENGINE_BLOCK", "1")
+    r_ker = equation_search(
+        X, y, options=Options(**opts), niterations=2, verbosity=0
+    )
+    monkeypatch.setattr(
+        interp_pallas, "evolve_block_supported", lambda *a, **k: False
+    )
+    r_ref = equation_search(
+        X, y, options=Options(**opts), niterations=2, verbosity=0
+    )
+    assert [m.complexity for m in r_ker.pareto_frontier] == [
+        m.complexity for m in r_ref.pareto_frontier
+    ]
+    np.testing.assert_allclose(
+        [m.loss for m in r_ker.pareto_frontier],
+        [m.loss for m in r_ref.pareto_frontier],
+        rtol=1e-6,
+    )

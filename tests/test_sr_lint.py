@@ -22,7 +22,7 @@ LINT_PY = os.path.join(REPO, "symbolicregression_jl_tpu", "analysis", "lint.py")
 
 RULE_IDS = [
     "SRL001", "SRL002", "SRL003", "SRL004", "SRL005", "SRL006", "SRL007",
-    "SRL008", "SRL009",
+    "SRL008", "SRL009", "SRL010",
 ]
 
 
