@@ -26,7 +26,16 @@ Three end-to-end cycles through the fault-tolerant runtime, minutes not hours:
    from its checkpoint, and land a frontier bit-identical to an
    uninterrupted run. Also exercises in-process: transient ``job_exception``
    retried to DONE and a persistent one escalated to QUARANTINED.
-5. **Pod federation**: two ``PodNode`` subprocesses over a shared
+5. **Network front door**: a ``NetServer`` subprocess on a fixed port with
+   a journaled ``SearchServer``; an ``SRClient`` submits 2 short + 1 long
+   job over the wire. A client is killed mid-stream (abrupt socket close
+   — the server must shrug); the server is SIGKILLed mid-run and
+   restarted on the SAME port + journal with ``torn_frame``/``net_drop``
+   faults armed. The surviving client must reconnect across the restart
+   (boot change) and both injected connection cuts, and the resumed
+   stream must be EXACTLY the server's stored frame list: zero lost,
+   zero duplicated jobs, exact frame replay by index.
+6. **Pod federation**: two ``PodNode`` subprocesses over a shared
    FileCoordStore serve a mixed queued/running workload; one host is
    SIGKILLed mid-batch with an exact lockstep snapshot on disk. The
    survivor must claim the dead host's journal generation, adopt every
@@ -37,8 +46,8 @@ Three end-to-end cycles through the fault-tolerant runtime, minutes not hours:
    marker, exit 0, and hand the jobs off to the survivor.
 
 Exits nonzero on the first violated invariant. Usage: python
-scripts/fault_smoke.py [checkpoint|exchange|elastic|serve|pod] (CI passes
-no args = all; JAX_PLATFORMS=cpu is forced).
+scripts/fault_smoke.py [checkpoint|exchange|elastic|serve|net|pod] (CI
+passes no args = all; JAX_PLATFORMS=cpu is forced).
 """
 
 from __future__ import annotations
@@ -512,6 +521,204 @@ def smoke_serve_durability() -> None:
     )
 
 
+_NET_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from symbolicregression_jl_tpu.serve import NetServer, SearchServer
+
+jdir, port = sys.argv[1], int(sys.argv[2])
+srv = SearchServer(max_concurrency=1, journal_dir=jdir,
+                   ckpt_every_s=0.05).start()
+net = NetServer(srv, port=port).start()
+print("READY", flush=True)
+time.sleep(3600)  # serve until the parent SIGKILLs this process
+"""
+
+
+def smoke_net_front_door() -> None:
+    import glob
+    import signal
+    import time
+
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.serve import JobSpec
+    from symbolicregression_jl_tpu.serve.net import ConnectionLost, SRClient
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+
+    def opts():
+        return Options(
+            binary_operators=["+", "-", "*"], unary_operators=["cos"],
+            populations=2, population_size=12, ncycles_per_iteration=8,
+            maxsize=12, seed=0, scheduler="lockstep", save_to_file=False,
+        )
+
+    # the restarted server must reclaim the SAME port so the surviving
+    # client's reconnect loop finds it without rediscovery
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    with tempfile.TemporaryDirectory() as d:
+        script = os.path.join(d, "net_child.py")
+        with open(script, "w") as f:
+            f.write(_NET_CHILD.format(repo=REPO))
+        jdir = os.path.join(d, "journal")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("SR_FAULT_SPEC", None)
+
+        def launch(fault_spec=None):
+            e = dict(env)
+            if fault_spec:
+                e["SR_FAULT_SPEC"] = fault_spec
+            p = subprocess.Popen(
+                [sys.executable, script, jdir, str(port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=e, cwd=REPO,
+            )
+            for line in p.stdout:
+                if line.startswith("READY"):
+                    return p
+            raise SystemExit("FAIL: net child never came up")
+
+        child = launch()
+        try:
+            # shorts first so the single worker drains them before the long
+            # job starts; the long job then runs alone with a wide kill window
+            doomed = SRClient("127.0.0.1", port, auto_reconnect=False)
+            shorts = [
+                doomed.submit(JobSpec(X, y, options=opts(), niterations=2))
+                for _ in range(2)
+            ]
+            long_id = doomed.submit(
+                JobSpec(X, y, options=opts(), niterations=40)
+            )
+            cli = SRClient("127.0.0.1", port, reconnect_deadline_s=120.0)
+            st = cli.subscribe(long_id)
+
+            # --- client-kill leg: abrupt close mid-stream -------------------
+            it = doomed.iter_frames(long_id, timeout=600)
+            got = [next(it), next(it)]
+            doomed.close()  # no unsubscribe, no goodbye — just gone
+            cli.ping()  # the server must not care
+            if got != cli.frames(long_id, 0)[: len(got)]:
+                raise SystemExit(
+                    "FAIL: killed client's frames are not a prefix of the "
+                    "server's stored stream"
+                )
+
+            # --- arm the kill: both shorts done, long mid-run + snapshot ----
+            for jid in shorts:
+                if cli.wait(jid, timeout=600)["state"] != "done":
+                    raise SystemExit(f"FAIL: short job {jid} not DONE")
+            spool = os.path.join(jdir, "spool", long_id + ".engine.*")
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if (cli.status(long_id)["iterations_done"] >= 3
+                        and glob.glob(spool)):
+                    break
+                time.sleep(0.05)
+            else:
+                raise SystemExit(
+                    "FAIL: long job never reached mid-run with a spool "
+                    "checkpoint"
+                )
+            child.send_signal(signal.SIGKILL)
+            child.wait(timeout=60)
+
+            # --- restart on the same port/journal, wire faults armed --------
+            # torn_frame@1: the restarted server's 2nd pushed frame is cut
+            # mid-frame; net_drop@3: a later push vanishes with the conn.
+            # Both must be invisible to the client beyond reconnect counts.
+            child = launch(fault_spec="torn_frame@1;net_drop@3")
+            terminal = None
+            deadline = time.time() + 600
+            while time.time() < deadline:
+                terminal = cli.terminal_summary(long_id)
+                if terminal is not None:
+                    break
+                time.sleep(0.1)
+            if terminal is None:
+                raise SystemExit(
+                    "FAIL: no terminal push for the recovered long job "
+                    f"(reconnects={cli.reconnects}, boots={st.boots})"
+                )
+            if terminal["state"] != "done":
+                raise SystemExit(f"FAIL: recovered long job: {terminal}")
+            if not terminal.get("resumed_from_iteration"):
+                raise SystemExit(
+                    "FAIL: recovered long job restarted from scratch: "
+                    f"{terminal}"
+                )
+
+            # --- zero lost/duplicated jobs; exact replay by index -----------
+            for jid in shorts:
+                summary = None
+                for _ in range(3):  # a fault may cut an in-flight request
+                    try:
+                        summary = cli.status(jid)
+                        break
+                    except (ConnectionLost, KeyError):
+                        time.sleep(0.5)
+                if summary is None or summary["state"] != "done":
+                    raise SystemExit(
+                        f"FAIL: short job {jid} lost across the restart: "
+                        f"{summary}"
+                    )
+            stats = cli.stats()
+            if stats["server"]["jobs"] != {"done": 3}:
+                raise SystemExit(
+                    "FAIL: recovered server job census is not 3x DONE: "
+                    f"{stats['server']['jobs']}"
+                )
+            stored = cli.frames(long_id, 0)
+            if st.boots != 1:
+                raise SystemExit(
+                    f"FAIL: expected exactly one boot change, saw {st.boots}"
+                )
+            if st.dup_dropped != 0:
+                raise SystemExit(
+                    f"FAIL: {st.dup_dropped} duplicate frame(s) delivered"
+                )
+            if st.next_index != len(stored):
+                raise SystemExit(
+                    f"FAIL: stream cursor {st.next_index} != stored frame "
+                    f"count {len(stored)}"
+                )
+            if st.frames[-len(stored):] != stored:
+                raise SystemExit(
+                    "FAIL: resumed stream differs from the server's stored "
+                    "frames (lost or reordered replay)"
+                )
+            if cli.reconnects < 3:
+                raise SystemExit(
+                    f"FAIL: expected >=3 reconnects (restart + torn_frame + "
+                    f"net_drop), saw {cli.reconnects}"
+                )
+            if stats["net"]["net_faults"] != 2:
+                raise SystemExit(
+                    "FAIL: armed wire faults did not both fire: "
+                    f"{stats['net']}"
+                )
+            cli.close()
+        finally:
+            child.kill()
+            child.wait(timeout=60)
+    print(
+        "OK network front door: server SIGKILL + torn frame + dropped conn "
+        f"survived with {cli.reconnects} reconnects; 3/3 jobs terminal, "
+        f"stream replayed exactly ({len(stored)} frames, 0 duplicates)"
+    )
+
+
 _POD_CHILD = """
 import os, sys, time
 sys.path.insert(0, {repo!r})
@@ -754,10 +961,10 @@ def smoke_pod_federation() -> None:
 if __name__ == "__main__":
     which = set(sys.argv[1:]) or {"all"}
     unknown = which - {"all", "checkpoint", "exchange", "elastic", "serve",
-                       "pod"}
+                       "net", "pod"}
     if unknown:
         sys.exit(f"unknown cycle(s): {sorted(unknown)} "
-                 "(choose from: checkpoint exchange elastic serve pod)")
+                 "(choose from: checkpoint exchange elastic serve net pod)")
     if which & {"all", "checkpoint"}:
         smoke_checkpoint_resume()
     if which & {"all", "exchange"}:
@@ -766,6 +973,8 @@ if __name__ == "__main__":
         smoke_elastic_rejoin()
     if which & {"all", "serve"}:
         smoke_serve_durability()
+    if which & {"all", "net"}:
+        smoke_net_front_door()
     if which & {"all", "pod"}:
         smoke_pod_federation()
     print("FAULT_SMOKE=pass")

@@ -1,0 +1,197 @@
+"""Network front-door smoke: a real server subprocess + the SDK over
+localhost TCP — the CI gate for `serve/net/`.
+
+A child process runs ``SearchServer`` + ``NetServer`` on an ephemeral
+port; the parent drives it purely through ``SRClient`` (no shared
+memory), the way an external user would.
+
+Asserts (the CI gate):
+- a mixed batch completes over one socket: two lockstep search jobs plus
+  one device-scheduler subscription job;
+- pushed frame streams decode as format-2 frontiers, and the pull-path
+  ``frames`` op replays byte-identically what was pushed;
+- ``push_rows`` over the wire lands in the live subscription (the stream
+  keeps producing frames afterwards);
+- ``cancel`` over the wire ends the subscription cleanly: terminal DONE
+  with stop_reason "cancelled";
+- ``stats`` round-trips the wire with the server and net counter blocks.
+
+Run: python scripts/net_smoke.py
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np  # noqa: E402
+
+from symbolicregression_jl_tpu import Options  # noqa: E402
+from symbolicregression_jl_tpu.serve import JobSpec  # noqa: E402
+from symbolicregression_jl_tpu.serve.net import SRClient  # noqa: E402
+from symbolicregression_jl_tpu.utils.checkpoint import (  # noqa: E402
+    load_frontier_bytes,
+)
+
+_CHILD = """
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, {root!r})
+from symbolicregression_jl_tpu.serve import NetServer, SearchServer
+
+srv = SearchServer(max_concurrency=2).start()
+net = NetServer(srv, port=0).start()
+print("PORT", net.port, flush=True)
+try:
+    while sys.stdin.readline():
+        pass
+finally:
+    net.shutdown()
+    srv.shutdown()
+"""
+
+
+def _problem(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _search_opts():
+    return Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=8,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+        scheduler="lockstep",
+    )
+
+
+def _sub_opts():
+    return Options(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=4,
+        population_size=16,
+        ncycles_per_iteration=40,
+        maxsize=14,
+        save_to_file=False,
+        seed=0,
+        scheduler="device",
+    )
+
+
+def main() -> int:
+    t0 = time.time()
+    script = os.path.join(tempfile.mkdtemp(prefix="sr-net-smoke-"), "server.py")
+    with open(script, "w") as fh:
+        fh.write(_CHILD.format(root=_ROOT))
+    child = subprocess.Popen(
+        [sys.executable, script],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=None,
+        text=True,
+    )
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("PORT "), f"server child said {line!r}"
+        port = int(line.split()[1])
+        print(f"[net_smoke] server child up on :{port} -- {time.time() - t0:.1f}s")
+
+        X, y = _problem(60)
+        with SRClient("127.0.0.1", port, tenant="smoke") as cli:
+            boot = cli.ping()["boot"]
+            # subscription first: it compiles its device program on one
+            # worker while the lockstep searches run on the other
+            sub = cli.submit(
+                JobSpec(
+                    X=X,
+                    y=y,
+                    options=_sub_opts(),
+                    kind="subscription",
+                    stream_config={"row_bucket": 64},
+                )
+            )
+            searches = [
+                cli.submit(
+                    JobSpec(
+                        X=X,
+                        y=y,
+                        options=_search_opts(),
+                        niterations=3,
+                        stream_every=1,
+                    )
+                )
+                for _ in range(2)
+            ]
+
+            # -- search legs: streamed frames decode + replay exactly ---------
+            for jid in searches:
+                frames = list(cli.iter_frames(jid, timeout=600))
+                assert frames, f"{jid}: no frames streamed"
+                update = load_frontier_bytes(frames[-1])
+                assert update.members, f"{jid}: empty frontier frame"
+                assert cli.frames(jid, 0) == frames, f"{jid}: replay mismatch"
+                summary = cli.wait(jid, timeout=120)
+                assert summary["state"] == "done", summary
+            print(
+                f"[net_smoke] 2 search jobs streamed + replayed exactly over "
+                f"the wire -- {time.time() - t0:.1f}s"
+            )
+
+            # -- subscription leg: first frame, live rows, more frames --------
+            stream = cli.iter_frames(sub, timeout=900)
+            first = next(stream)
+            best0 = min(m.loss for m in load_frontier_bytes(first).members)
+            print(
+                f"[net_smoke] subscription first frame: best loss "
+                f"{best0:.4f} -- {time.time() - t0:.1f}s"
+            )
+            Xn, yn = _problem(4, seed=5)
+            cli.push_rows(sub, Xn, yn)  # 60 -> 64 rows, in-bucket
+            after_push = next(stream)  # the lane keeps producing frames
+            assert load_frontier_bytes(after_push).members
+            print(
+                f"[net_smoke] push_rows over the wire accepted; stream still "
+                f"live -- {time.time() - t0:.1f}s"
+            )
+
+            # -- clean cancel over the wire -----------------------------------
+            cli.cancel(sub)
+            summary = cli.wait(sub, timeout=600)
+            assert summary["state"] == "done", summary
+            assert summary["stop_reason"] == "cancelled", summary
+            print(
+                f"[net_smoke] subscription cancelled cleanly after "
+                f"{summary['iterations_done']} iterations, "
+                f"{summary['frames']} frames -- {time.time() - t0:.1f}s"
+            )
+
+            stats = cli.stats()
+            assert stats["net"]["boot"] == boot
+            assert stats["net"]["frames_pushed"] >= 3
+            assert stats["server"]["jobs"].get("done", 0) >= 3
+            assert cli.reconnects == 0, "smoke should not need reconnects"
+    finally:
+        try:
+            child.stdin.close()
+            child.wait(timeout=30)
+        except Exception:
+            child.kill()
+    print(f"[net_smoke] OK in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -169,13 +169,19 @@ def _take(mat, idx):
     read (exact for every dtype, inf/nan-safe — no multiplies)."""
     V = mat.shape[-1]
     oh = idx[..., None] == _it(V)
-    return jnp.sum(jnp.where(oh, mat, jnp.zeros((), mat.dtype)), axis=-1)
+    # dtype pinned: integer sums otherwise widen to int64 under x64 (flipped
+    # process-globally by any f64 search) and break the fori_loop carry
+    return jnp.sum(
+        jnp.where(oh, mat, jnp.zeros((), mat.dtype)), axis=-1, dtype=mat.dtype
+    )
 
 
 def _gather_vec(vec, idx):
     """vec [V], idx [...] -> [...]."""
     oh = idx[..., None] == _it(vec.shape[0])
-    return jnp.sum(jnp.where(oh, vec, jnp.zeros((), vec.dtype)), axis=-1)
+    return jnp.sum(
+        jnp.where(oh, vec, jnp.zeros((), vec.dtype)), axis=-1, dtype=vec.dtype
+    )
 
 
 def _gather_rows(mat, idx):
@@ -184,6 +190,7 @@ def _gather_rows(mat, idx):
     return jnp.sum(
         jnp.where(oh[:, :, None], mat[None, :, :], jnp.zeros((), mat.dtype)),
         axis=1,
+        dtype=mat.dtype,
     )
 
 
@@ -193,7 +200,9 @@ def _permute_cols(mat, src, use_move):
     N = mat.shape[-1]
     oh = src[:, :, None] == _it(N)  # [E, N, N]
     g = jnp.sum(
-        jnp.where(oh, mat[:, None, :], jnp.zeros((), mat.dtype)), axis=-1
+        jnp.where(oh, mat[:, None, :], jnp.zeros((), mat.dtype)),
+        axis=-1,
+        dtype=mat.dtype,
     )
     return jnp.where(use_move, g, mat)
 
@@ -206,7 +215,7 @@ def _first_true(mask):
 
 def _cumsum_i32(mask):
     """Inclusive cumsum of a bool mask along the last axis, int32."""
-    return jnp.cumsum(mask.astype(jnp.int32), axis=-1)
+    return jnp.cumsum(mask.astype(jnp.int32), axis=-1, dtype=jnp.int32)
 
 
 def _pick_ranked(mask, u, count):

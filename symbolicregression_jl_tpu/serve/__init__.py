@@ -19,9 +19,22 @@ Three layers:
   over a shared CoordStore presenting one logical service, with
   warmth/load-aware admission, lane migration off dead hosts, and
   SIGTERM graceful drain.
+- ``net`` — the network front door: ``NetServer`` (CRC-framed wire
+  protocol over asyncio, auth-token→tenant, retryable overload shed)
+  and the ``SRClient``/``AsyncSRClient`` SDK with reconnect +
+  resume-from-frame-index streaming.
 """
 
 from .journal import JobJournal
+from .net import (
+    AsyncSRClient,
+    ConnectionLost,
+    NetError,
+    NetServer,
+    RetryableWireError,
+    SRClient,
+    WireError,
+)
 from .pod import PodClient, PodNode
 from .program_cache import (
     ProgramCache,
@@ -61,6 +74,13 @@ __all__ = [
     "ServerOverloaded",
     "PodNode",
     "PodClient",
+    "NetServer",
+    "SRClient",
+    "AsyncSRClient",
+    "NetError",
+    "WireError",
+    "RetryableWireError",
+    "ConnectionLost",
     "shape_bucket",
     "options_digest",
     "bucket_digest",

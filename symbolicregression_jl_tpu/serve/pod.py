@@ -67,6 +67,7 @@ import threading
 import time
 import uuid
 
+from ..parallel.distributed import kv_backoff_max_ms, kv_backoff_ms
 from ..parallel.membership import CoordStore, FileCoordStore, coord_store
 from . import queue as q
 from .queue import JobSpec, ServerOverloaded, bucket_digest, shape_bucket
@@ -84,6 +85,24 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _poll_backoff(poll: float):
+    """Sleep schedule for the ``wait_*`` pollers: stay at the caller's
+    ``poll`` interval for the first ``SR_KV_BACKOFF_MS`` of idle waiting
+    (keeps first-frame / short-job latency tight), then double each idle
+    poll up to ``SR_KV_BACKOFF_MAX_MS`` — the same knobs the KV gather's
+    retry loop uses — so a long wait stops hammering the coordination
+    store at a fixed interval."""
+    fast_for = kv_backoff_ms() / 1000.0
+    cap = max(poll, kv_backoff_max_ms() / 1000.0)
+    interval = max(1e-4, poll)
+    waited = 0.0
+    while True:
+        yield interval
+        waited += interval
+        if waited >= fast_for:
+            interval = min(interval * 2.0, cap)
 
 
 class _PodKeys:
@@ -737,13 +756,15 @@ class PodClient:
 
     def wait(self, pjid: str, timeout: float = 300.0, poll: float = 0.05) -> dict:
         deadline = time.monotonic() + timeout
+        backoff = _poll_backoff(poll)
         while True:
             rec = self.done(pjid)
             if rec is not None:
                 return rec
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise TimeoutError(f"pod job {pjid} not terminal in {timeout}s")
-            time.sleep(poll)
+            time.sleep(min(next(backoff), deadline - now))
 
     def wait_first_frame(
         self, pjid: str, timeout: float = 300.0, poll: float = 0.02
@@ -752,15 +773,17 @@ class PodClient:
         is visible; returns the wall-clock time it was observed — the
         client-side TTFF instant."""
         deadline = time.monotonic() + timeout
+        backoff = _poll_backoff(poll)
         while True:
             if (
                 self.latest_frame(pjid) is not None
                 or self.done(pjid) is not None
             ):
                 return time.time()
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise TimeoutError(f"pod job {pjid}: no frame in {timeout}s")
-            time.sleep(poll)
+            time.sleep(min(next(backoff), deadline - now))
 
     def wait_all(
         self, pjids, timeout: float = 600.0, poll: float = 0.05
@@ -768,19 +791,25 @@ class PodClient:
         deadline = time.monotonic() + timeout
         out: dict[str, dict] = {}
         pending = list(pjids)
+        backoff = _poll_backoff(poll)
         while pending:
+            progressed = False
             for pjid in list(pending):
                 rec = self.done(pjid)
                 if rec is not None:
                     out[pjid] = rec
                     pending.remove(pjid)
+                    progressed = True
             if not pending:
                 break
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise TimeoutError(
                     f"pod jobs not terminal in {timeout}s: {pending}"
                 )
-            time.sleep(poll)
+            if progressed:  # results are landing — reset to the fast poll
+                backoff = _poll_backoff(poll)
+            time.sleep(min(next(backoff), deadline - now))
         return out
 
     def results(self) -> dict[str, dict]:

@@ -207,6 +207,11 @@ class SearchServer:
         self._queue = JobQueue(default_quota=default_quota, quotas=quotas)
         self._lock = threading.Lock()
         self._frame_cond = threading.Condition(self._lock)
+        # Monotone counter bumped on every frame append / terminal
+        # transition; wait_activity() lets a single external bridge thread
+        # (e.g. the NetServer fan-out) sleep on ALL jobs at once instead of
+        # polling each stream.
+        self._activity = 0
         self._jobs: dict[str, Job] = {}
         self._running: dict[str, Job] = {}
         self._warm_buckets: set = set()
@@ -579,6 +584,28 @@ class SearchServer:
         with self._lock:
             return list(job.frames[start:])
 
+    def frames_since(self, job_id: str, start: int = 0) -> tuple[list[bytes], bool]:
+        """``(frames[start:], terminal)`` captured under ONE lock
+        acquisition — the terminal flag is consistent with the frame
+        snapshot, so a reader that sees ``terminal=True`` holds every frame
+        the job will ever produce. This is the fan-out primitive for
+        high-frequency network readers (``frames()`` + a separate terminal
+        check would contend the server lock twice per batch and could race
+        a frame appended between the two)."""
+        with self._lock:
+            job = self._jobs[job_id]
+            return list(job.frames[start:]), job.terminal
+
+    def wait_activity(self, last_seen: int = 0, timeout: float | None = None) -> int:
+        """Block until the server-wide activity counter advances past
+        ``last_seen`` (any frame append or terminal transition on any job),
+        or until ``timeout``; returns the current counter. Lets one bridge
+        thread multiplex wakeups for many streams."""
+        with self._frame_cond:
+            if self._activity == last_seen:
+                self._frame_cond.wait(timeout)
+            return self._activity
+
     def stream(self, job_id: str, timeout: float | None = None):
         """Generator over frontier frames as they arrive, ending when the job
         goes terminal (yields every frame exactly once)."""
@@ -599,11 +626,14 @@ class SearchServer:
                         else min(self.poll_seconds, remaining)
                     ):
                         continue
+                # One consistent snapshot: batch + terminal under the same
+                # acquisition, so the post-yield exit check needs no re-lock.
                 batch = list(job.frames[i:])
+                terminal = job.terminal
             for frame in batch:
                 yield frame
             i += len(batch)
-            if job.terminal and i >= len(self.frames(job_id)):
+            if terminal:
                 return
 
     def cancel(self, job_id: str) -> None:
@@ -862,6 +892,7 @@ class SearchServer:
                     job.frames.append(frame)
                     if job.ttff is None:
                         job.ttff = time.time() - job.submitted_at
+                    self._activity += 1
                     self._frame_cond.notify_all()
             cancelled = (
                 all(j.cancel_requested.is_set() for j in group)
@@ -1037,6 +1068,7 @@ class SearchServer:
                 job.frames.append(frame)
                 if job.ttff is None:
                     job.ttff = time.time() - job.submitted_at
+                self._activity += 1
                 self._frame_cond.notify_all()
 
         user_cb = spec.options.iteration_callback
@@ -1300,6 +1332,7 @@ class SearchServer:
             job.frames.append(frame)
             if job.ttff is None:
                 job.ttff = time.time() - job.submitted_at
+            self._activity += 1
             self._frame_cond.notify_all()
 
     def _release_running(self, job: Job) -> None:
@@ -1415,6 +1448,7 @@ class SearchServer:
         with self._frame_cond:
             job.state = state
             job.finished_at = time.time()
+            self._activity += 1
             self._frame_cond.notify_all()
         if self.journal is not None:
             self._jappend("terminal", job.id, state=state, error=job.error)

@@ -55,6 +55,18 @@ Fault sites (each scheduler documents which it consults):
   watchdog must detect the frozen ``iterations_done``, request cooperative
   stop, and retry the job (the sleep polls the stop request, so the stall
   resolves the moment the watchdog fires).
+- ``net_drop`` — the ``NetServer`` connection aborts (RST, nothing
+  flushed) just before writing the Nth pushed stream frame: the
+  kill-a-connection-mid-stream drill. Clients must reconnect and resume
+  from their frame index with zero lost or duplicated frames.
+- ``slow_client`` — the SDK's reader sleeps ``delay_ms`` (default 1000)
+  before each receive, modelling a client that stops draining its socket;
+  the server's bounded send queue / ``SR_NET_SLOW_CLIENT_S`` drain timeout
+  must shed the connection instead of buffering without bound.
+- ``torn_frame`` — the ``NetServer`` writes only HALF of one pushed wire
+  frame (flushed) and aborts the connection — the network analogue of
+  ``journal_torn_write``. The client codec must discard the torn tail on
+  reconnect and the index-based resume must replay exactly.
 
 One injector is active per process at a time: ``install()`` (called by the
 schedulers when ``Options.fault_spec`` is set, resetting call counts) takes
@@ -91,6 +103,9 @@ FAULT_SITES = (
     "job_exception",
     "journal_torn_write",
     "stall",
+    "net_drop",
+    "slow_client",
+    "torn_frame",
 )
 
 

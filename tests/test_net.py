@@ -1,0 +1,409 @@
+"""Network front door (r17): wire codec torn-frame discipline, NetServer +
+SDK round trips, auth→tenant mapping, retryable overload, reconnect with
+resume-from-frame-index, and the PodClient wait-loop backoff.
+
+Codec tests are pure stdlib. Engine-driving tests use tiny LOCKSTEP
+configs (no device compile) against a localhost ``NetServer`` — a warm
+search is ~0.15s on CPU. The device-scheduler subscription leg lives in
+``scripts/net_smoke.py`` (a dedicated CI step), not here.
+"""
+
+import asyncio
+import pickle
+import struct
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu import Options
+from symbolicregression_jl_tpu.serve import (
+    JobSpec,
+    NetServer,
+    SearchServer,
+    SRClient,
+)
+from symbolicregression_jl_tpu.serve.net import (
+    WIRE_MAGIC,
+    AsyncSRClient,
+    AuthError,
+    FrameDecoder,
+    RemoteError,
+    RetryableWireError,
+    WireError,
+    decode_message,
+    encode_frame,
+    encode_message,
+    max_frame_bytes,
+)
+from symbolicregression_jl_tpu.serve.journal import JOURNAL_MAGIC
+from symbolicregression_jl_tpu.serve.pod import PodClient, _poll_backoff
+from symbolicregression_jl_tpu.utils import faults
+
+
+def _problem(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(2, n)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0] ** 2 - 2).astype(np.float32)
+    return X, y
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        populations=2,
+        population_size=8,
+        ncycles_per_iteration=8,
+        maxsize=10,
+        save_to_file=False,
+        seed=0,
+        scheduler="lockstep",
+    )
+    base.update(kw)
+    return Options(**base)
+
+
+def _spec(X, y, **kw):
+    kw.setdefault("options", _opts())
+    kw.setdefault("niterations", 2)
+    return JobSpec(X, y, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.install(None)
+
+
+# -- wire codec (no engine, no sockets) ----------------------------------------
+
+
+def test_wire_magic_is_distinct_from_journal():
+    assert len(WIRE_MAGIC) == len(JOURNAL_MAGIC) == 8
+    assert WIRE_MAGIC != JOURNAL_MAGIC
+
+
+def test_codec_roundtrip_single_and_batched():
+    msgs = [{"op": "ping", "rid": i, "blob": bytes(range(i % 7))} for i in range(5)]
+    wire = b"".join(encode_message(m) for m in msgs)
+    got = FrameDecoder().feed_messages(wire)
+    assert got == msgs
+
+
+def test_codec_truncation_at_every_byte_offset():
+    """A frame cut at ANY byte offset yields no message and no error —
+    the bytes stay buffered awaiting the rest (the torn-tail discipline:
+    a partial frame is pending, never mis-parsed)."""
+    msg = {"op": "submit", "rid": 7, "payload": b"x" * 37}
+    frame = encode_message(msg)
+    for cut in range(len(frame)):
+        dec = FrameDecoder()
+        assert dec.feed_messages(frame[:cut]) == []
+        assert dec.buffered == cut
+        # the remaining bytes complete exactly the original message
+        assert dec.feed_messages(frame[cut:]) == [msg]
+        assert dec.buffered == 0
+
+
+def test_codec_interleaved_partial_reads():
+    """Byte-at-a-time and ragged-chunk delivery both reassemble exactly."""
+    msgs = [{"rid": i, "data": bytes([i]) * (3 * i + 1)} for i in range(8)]
+    wire = b"".join(encode_message(m) for m in msgs)
+    # one byte at a time
+    dec = FrameDecoder()
+    got = []
+    for i in range(len(wire)):
+        got += dec.feed_messages(wire[i : i + 1])
+    assert got == msgs
+    # ragged prime-sized chunks
+    dec = FrameDecoder()
+    got, i = [], 0
+    for step in [1, 2, 3, 5, 7, 11, 13]* 200:
+        if i >= len(wire):
+            break
+        got += dec.feed_messages(wire[i : i + step])
+        i += step
+    got += dec.feed_messages(wire[i:])
+    assert got == msgs
+
+
+def test_codec_oversized_length_header_rejected():
+    huge = struct.pack("<II", (1 << 31), 0) + b"junk"
+    with pytest.raises(WireError, match="length header"):
+        FrameDecoder().feed(huge)
+    # bound is enforced on encode too (small decoder bound to avoid a
+    # 64MB allocation here)
+    small = FrameDecoder(max_bytes=1024)
+    with pytest.raises(WireError, match="length header"):
+        small.feed(struct.pack("<II", 2048, 0))
+    with pytest.raises(WireError, match="exceeds"):
+        encode_frame(b"x" * (max_frame_bytes() + 1))
+
+
+def test_codec_crc_mismatch_garbage():
+    frame = bytearray(encode_message({"a": 1}))
+    frame[-1] ^= 0xFF  # corrupt one payload byte
+    with pytest.raises(WireError, match="CRC"):
+        FrameDecoder().feed(bytes(frame))
+    # corrupt the stored CRC instead of the payload
+    frame = bytearray(encode_message({"a": 1}))
+    frame[4] ^= 0xFF
+    with pytest.raises(WireError, match="CRC"):
+        FrameDecoder().feed(bytes(frame))
+
+
+def test_codec_valid_crc_nondict_payload_rejected():
+    payload = pickle.dumps([1, 2, 3])
+    frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+    (raw,) = FrameDecoder().feed(frame)  # framing passes...
+    with pytest.raises(WireError, match="expected dict"):
+        decode_message(raw)  # ...but the message layer rejects it
+
+
+def test_codec_unpicklable_garbage_with_valid_crc():
+    payload = b"\x00\x01\x02 not a pickle"
+    frame = struct.pack("<II", len(payload), zlib.crc32(payload)) + payload
+    (raw,) = FrameDecoder().feed(frame)
+    with pytest.raises(WireError, match="undecodable"):
+        decode_message(raw)
+
+
+# -- frames_since / wait_activity (satellite: single-lock stream snapshot) -----
+
+
+def test_frames_since_single_snapshot_and_stream_parity():
+    X, y = _problem()
+    with SearchServer(max_concurrency=1) as srv:
+        jid = srv.submit(_spec(X, y, niterations=3, stream_every=1))
+        streamed = list(srv.stream(jid, timeout=120))
+        frames, terminal = srv.frames_since(jid, 0)
+        assert terminal and frames == streamed and len(frames) >= 1
+        tail, terminal2 = srv.frames_since(jid, len(frames) - 1)
+        assert terminal2 and tail == frames[-1:]
+        with pytest.raises(KeyError):
+            srv.frames_since("job-99999", 0)
+
+
+def test_wait_activity_advances_on_frames_and_terminal():
+    X, y = _problem()
+    with SearchServer(max_concurrency=1) as srv:
+        before = srv.wait_activity(0, timeout=0.0)
+        jid = srv.submit(_spec(X, y, niterations=2, stream_every=1))
+        srv.wait(jid, timeout=120)
+        after = srv.wait_activity(before, timeout=5.0)
+        # >= frames + terminal transitions
+        assert after >= before + len(srv.frames(jid)) + 1
+        # no activity: returns unchanged after the timeout
+        assert srv.wait_activity(after, timeout=0.05) == after
+
+
+# -- NetServer + SRClient round trips (lockstep engine) ------------------------
+
+
+def test_wire_submit_stream_wait_roundtrip():
+    X, y = _problem()
+    with SearchServer(max_concurrency=2) as srv:
+        with NetServer(srv, port=0) as net:
+            with SRClient("127.0.0.1", net.port, tenant="t0") as cli:
+                assert cli.ping()["boot"] == net.boot
+                jid = cli.submit(_spec(X, y, niterations=3, stream_every=1))
+                frames = list(cli.iter_frames(jid, timeout=120))
+                summary = cli.wait(jid, timeout=60)
+                assert summary["state"] == "done"
+                assert len(frames) == summary["frames"] >= 1
+                # pull-path replay equals the pushed stream, byte for byte
+                assert cli.frames(jid, 0) == frames
+                update = cli.decode_frame(frames[-1])
+                assert update.members and update.iteration >= 1
+                status = cli.status(jid)
+                assert status["state"] == "done"
+                stats = cli.stats()
+                assert stats["net"]["frames_pushed"] >= len(frames)
+                assert stats["server"]["jobs"].get("done", 0) >= 1
+
+
+def test_wire_cancel_and_unknown_job():
+    X, y = _problem()
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0) as net:
+            with SRClient("127.0.0.1", net.port) as cli:
+                blocker = cli.submit(_spec(X, y, niterations=50, stream_every=1))
+                queued = cli.submit(_spec(X, y, niterations=50))
+                cli.cancel(queued)
+                cli.cancel(blocker)
+                assert cli.wait(blocker, timeout=120)["state"] in (
+                    "cancelled",
+                    "done",
+                )
+                assert cli.wait(queued, timeout=60)["state"] == "cancelled"
+                with pytest.raises(KeyError):
+                    cli.status("job-99999")
+                with pytest.raises(RemoteError):
+                    cli._request({"op": "bogus"})
+
+
+def test_wire_auth_token_maps_tenant_and_rejects_unknown():
+    X, y = _problem()
+    tokens = {"sekrit-a": "alice", "sekrit-b": "bob"}
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0, tokens=tokens) as net:
+            with SRClient("127.0.0.1", net.port, token="sekrit-a") as cli:
+                assert cli.tenant == "alice"
+                # the spec's self-declared tenant is overridden by the token
+                jid = cli.submit(_spec(X, y, tenant="mallory"))
+                assert cli.wait(jid, timeout=120)["tenant"] == "alice"
+            with pytest.raises(AuthError):
+                SRClient("127.0.0.1", net.port, token="wrong",
+                         auto_reconnect=False)
+
+
+def test_wire_overload_is_retryable_with_hint():
+    X, y = _problem()
+    with SearchServer(max_concurrency=1, queue_max_depth=1) as srv:
+        with NetServer(srv, port=0) as net:
+            with SRClient("127.0.0.1", net.port) as cli:
+                jids = [cli.submit(_spec(X, y, niterations=60))]
+                shed = None
+                for _ in range(8):
+                    try:
+                        jids.append(cli.submit(_spec(X, y, niterations=60)))
+                    except RetryableWireError as exc:
+                        shed = exc
+                        break
+                assert shed is not None, "queue_max_depth=1 never shed"
+                assert shed.retry_after_s > 0
+                for jid in jids:
+                    cli.cancel(jid)
+                for jid in jids:
+                    cli.wait(jid, timeout=120)
+
+
+def test_wire_reconnect_resumes_stream_exactly_once():
+    """torn_frame aborts the connection half-way through a pushed frame:
+    the client's codec rejects the torn tail, reconnects, re-subscribes
+    from its index, and the final stream has no gap and no duplicate."""
+    X, y = _problem()
+    faults.install("torn_frame@2")
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0) as net:
+            with SRClient("127.0.0.1", net.port) as cli:
+                jid = cli.submit(_spec(X, y, niterations=8, stream_every=1))
+                frames = list(cli.iter_frames(jid, timeout=120))
+                assert cli.reconnects >= 1
+                assert frames == srv.frames(jid)  # exact replay, no dup/loss
+                st = cli.stream_state(jid)
+                assert st.next_index == len(frames)
+                assert net.net_stats()["net_faults"] == 1
+
+
+def test_wire_net_drop_reconnect():
+    X, y = _problem()
+    faults.install("net_drop@1")
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0) as net:
+            with SRClient("127.0.0.1", net.port) as cli:
+                jid = cli.submit(_spec(X, y, niterations=6, stream_every=1))
+                frames = list(cli.iter_frames(jid, timeout=120))
+                assert cli.reconnects >= 1
+                assert frames == srv.frames(jid)
+                assert net.net_stats()["net_faults"] == 1
+
+
+def test_wire_slow_client_fault_stalls_but_loses_nothing():
+    X, y = _problem()
+    faults.install("slow_client@2:delay_ms=300")
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0) as net:
+            with SRClient("127.0.0.1", net.port) as cli:
+                jid = cli.submit(_spec(X, y, niterations=5, stream_every=1))
+                frames = list(cli.iter_frames(jid, timeout=120))
+                assert frames == srv.frames(jid)
+
+
+def test_async_client_submit_and_stream():
+    X, y = _problem()
+
+    async def run(port):
+        cli = await AsyncSRClient.connect("127.0.0.1", port)
+        try:
+            jid = await cli.submit(_spec(X, y, niterations=3, stream_every=1))
+            frames = [f async for f in cli.iter_frames(jid, timeout=120)]
+            summary = await cli.wait(jid, timeout=60)
+            assert summary["state"] == "done"
+            assert len(frames) == summary["frames"] >= 1
+            assert (await cli.frames(jid)) == frames
+            return True
+        finally:
+            await cli.close()
+
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0) as net:
+            assert asyncio.run(run(net.port))
+
+
+def test_non_protocol_peer_is_dropped_cleanly():
+    import socket as socketmod
+
+    with SearchServer(max_concurrency=1) as srv:
+        with NetServer(srv, port=0) as net:
+            s = socketmod.create_connection(("127.0.0.1", net.port), timeout=5)
+            try:
+                s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+                s.settimeout(5)
+                # server sends its magic, then hangs up on the bad magic
+                data = b""
+                while True:
+                    try:
+                        chunk = s.recv(4096)
+                    except OSError:
+                        break
+                    if not chunk:
+                        break
+                    data += chunk
+                assert data.startswith(WIRE_MAGIC)
+            finally:
+                s.close()
+
+
+# -- PodClient wait backoff (satellite) ----------------------------------------
+
+
+def test_poll_backoff_schedule(monkeypatch):
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "100")
+    monkeypatch.setenv("SR_KV_BACKOFF_MAX_MS", "400")
+    gen = _poll_backoff(0.05)
+    got = [round(next(gen), 4) for _ in range(7)]
+    # fast at poll for the first 100ms of waiting, then doubles to the cap
+    assert got == [0.05, 0.05, 0.1, 0.2, 0.4, 0.4, 0.4]
+
+
+def test_poll_backoff_cap_never_below_poll(monkeypatch):
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "0")
+    monkeypatch.setenv("SR_KV_BACKOFF_MAX_MS", "10")
+    gen = _poll_backoff(0.05)
+    # cap clamps to poll, never below it
+    assert [next(gen) for _ in range(3)] == [0.05, 0.05, 0.05]
+
+
+def test_pod_wait_backs_off_but_honors_deadline(tmp_path, monkeypatch):
+    from symbolicregression_jl_tpu.parallel.membership import FileCoordStore
+
+    monkeypatch.setenv("SR_KV_BACKOFF_MS", "20")
+    monkeypatch.setenv("SR_KV_BACKOFF_MAX_MS", "200")
+    cli = PodClient(store=FileCoordStore(str(tmp_path / "kv")), pod_id="t")
+    sleeps: list[float] = []
+    real_sleep = time.sleep
+    monkeypatch.setattr(
+        "symbolicregression_jl_tpu.serve.pod.time.sleep",
+        lambda s: (sleeps.append(s), real_sleep(min(s, 0.002)))[0],
+    )
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        cli.wait("pj-none", timeout=0.5, poll=0.01)
+    assert time.monotonic() - t0 < 5.0
+    assert len(sleeps) >= 3
+    # intervals grow (exponential), stay capped, and never overshoot
+    assert max(sleeps) <= 0.2 + 1e-6
+    assert any(b > a for a, b in zip(sleeps, sleeps[1:]))
