@@ -1,0 +1,541 @@
+#!/usr/bin/env python
+"""Seeded chaos soak: one integer seed -> a deterministic multi-fault
+schedule over the full serving stack, audited against global invariants.
+
+The rig (CPU backend, all local subprocesses):
+
+- two or three ``PodNode`` children sharing one FileCoordStore
+  (``SR_COORD_DIR``), each with its own CRC journal + spool checkpoints;
+- one ``NetServer`` child fronting a journaled ``SearchServer`` on a TCP
+  port (the wire/stream layer);
+- this parent process as orchestrator: it submits a solo/fleet/stream job
+  mix via ``PodClient`` and ``SRClient``, fires the schedule's ``kill``
+  events (SIGKILL + respawn), and feeds every observation to
+  :class:`~symbolicregression_jl_tpu.utils.invariants.InvariantAuditor`.
+
+Faults are routed per process: each child boots with the
+``SR_FAULT_SPEC`` slice of the schedule addressed to it (see
+``utils.chaos.host_env_spec``). A respawned child re-arms its slice —
+call counts reset with the process, which is exactly what a real
+recurring fault does.
+
+Invariants audited (see ``utils/invariants.py``): exactly-once done
+ledger, zero lost jobs, exact stream replay by index, every frame
+decodes, every journal replays idempotently post-mortem, resumed jobs
+finish their full budget, queue depth and the read-only journal buffer
+stay bounded.
+
+On a breach the soak exits 1 and — unless ``--no-shrink`` — delta-debugs
+the schedule (``utils.chaos.ddmin``) by re-running short soaks, then
+emits a minimal ``SR_FAULT_SPEC``-grammar repro string (stdout + artifact
+file) that reproduces the breach.
+
+Demo of the whole loop (deliberately reverted degradation):
+
+    python scripts/chaos_soak.py --seed 0 --duration 25 \\
+        --break shed_silently \\
+        --schedule 'disk_full@0:clear=1,host=h0,path=journal;ckpt_crash@0:host=h1;slow_client@1:delay_ms=100,host=net'
+
+``--break shed_silently`` makes ``SearchServer.submit`` swallow the
+disk-full shed instead of refusing it — the auditor must report
+``no_lost_jobs`` and the shrinker must reduce the schedule to the single
+``disk_full`` rule.
+
+Usage: python scripts/chaos_soak.py --seed S --duration 60
+Exit codes: 0 = all invariants held, 1 = breach, 2 = rig error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_POD_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+host = sys.argv[1]
+
+# coord_store() (not FileCoordStore directly) so an armed kv_partition
+# rule wraps the store in this process
+from symbolicregression_jl_tpu.parallel.membership import coord_store
+from symbolicregression_jl_tpu.serve import PodNode
+
+node = PodNode(host, store=coord_store(), hb_seconds=0.1,
+               suspect_seconds=2.0, max_concurrency=1, poll_seconds=0.02,
+               ckpt_every_s=0.1)
+node.install_sigterm_drain()
+node.start()
+print("READY " + host, flush=True)
+time.sleep(100000)  # serve until the parent kills us
+"""
+
+_NET_CHILD = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from symbolicregression_jl_tpu.serve import NetServer, SearchServer
+
+jdir, port = sys.argv[1], int(sys.argv[2])
+srv = SearchServer(max_concurrency=1, journal_dir=jdir,
+                   ckpt_every_s=0.05).start()
+net = NetServer(srv, port=port).start()
+print("READY net", flush=True)
+time.sleep(100000)  # serve until the parent kills us
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 64)).astype(np.float32)
+    y = (2 * np.cos(X[1]) + X[0]).astype(np.float32)
+    return X, y
+
+
+def _opts(seed=0):
+    from symbolicregression_jl_tpu import Options
+
+    return Options(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        populations=2, population_size=12, ncycles_per_iteration=8,
+        maxsize=12, seed=seed, scheduler="lockstep", save_to_file=False,
+    )
+
+
+class _Rig:
+    """Child process bookkeeping: spawn, SIGKILL, respawn, logs."""
+
+    def __init__(self, workdir: str, schedule, hosts, break_mode):
+        from symbolicregression_jl_tpu.utils import chaos
+
+        self.workdir = workdir
+        self.schedule = schedule
+        self.hosts = tuple(hosts)
+        self.break_mode = break_mode
+        self.coord = os.path.join(workdir, "coord")
+        self.net_journal = os.path.join(workdir, "net_journal")
+        self.port = _free_port()
+        self.procs: dict[str, subprocess.Popen] = {}
+        self._logs: dict[str, object] = {}
+        self.pod_script = os.path.join(workdir, "pod_child.py")
+        self.net_script = os.path.join(workdir, "net_child.py")
+        with open(self.pod_script, "w") as f:
+            f.write(_POD_CHILD.format(repo=REPO))
+        with open(self.net_script, "w") as f:
+            f.write(_NET_CHILD.format(repo=REPO))
+        self._chaos = chaos
+
+    def _env(self, name: str) -> dict:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop("SR_FAULT_SPEC", None)
+        env.pop("SR_CHAOS_BREAK", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SR_QUEUE_MAX_DEPTH"] = "32"
+        spec = self._chaos.host_env_spec(self.schedule, name)
+        if spec:
+            env["SR_FAULT_SPEC"] = spec
+        if self.break_mode:
+            env["SR_CHAOS_BREAK"] = self.break_mode
+        if name != "net":
+            env["SR_COORD_DIR"] = self.coord
+            env["SR_POD_HOST"] = name
+        return env
+
+    def spawn(self, name: str) -> None:
+        log = open(os.path.join(self.workdir, f"{name}.log"), "ab")
+        self._logs[name] = log
+        if name == "net":
+            argv = [sys.executable, self.net_script, self.net_journal,
+                    str(self.port)]
+        else:
+            argv = [sys.executable, self.pod_script, name]
+        self.procs[name] = subprocess.Popen(
+            argv, stdout=log, stderr=subprocess.STDOUT,
+            env=self._env(name), cwd=REPO,
+        )
+
+    def kill(self, name: str) -> None:
+        p = self.procs.get(name)
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=60)
+
+    def teardown(self) -> None:
+        for name in list(self.procs):
+            try:
+                self.kill(name)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        for log in self._logs.values():
+            try:
+                log.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def tail_logs(self, n: int = 30) -> str:
+        out = []
+        for name in self.procs:
+            path = os.path.join(self.workdir, f"{name}.log")
+            try:
+                with open(path, "r", errors="replace") as f:
+                    lines = f.readlines()[-n:]
+                out.append(f"--- {name} ---\n" + "".join(lines))
+            except OSError:
+                pass
+        return "\n".join(out)
+
+
+def run_soak(
+    schedule,
+    duration_s: float,
+    workdir: str,
+    hosts=("h0", "h1"),
+    break_mode: str | None = None,
+    verbose: bool = True,
+):
+    """Drive one soak; returns the finalized InvariantAuditor."""
+    from symbolicregression_jl_tpu.parallel.membership import FileCoordStore
+    from symbolicregression_jl_tpu.serve import JobSpec, PodClient
+    from symbolicregression_jl_tpu.serve.net import SRClient
+    from symbolicregression_jl_tpu.utils.chaos import kill_events
+    from symbolicregression_jl_tpu.utils.invariants import InvariantAuditor
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"[chaos] {msg}", flush=True)
+
+    X, y = _dataset()
+    auditor = InvariantAuditor(queue_max_depth=32)
+    rig = _Rig(workdir, schedule, hosts, break_mode)
+    kills = kill_events(schedule)
+    pending_respawn: list[tuple[float, str]] = []
+    net_ids: list[str] = []
+    long_id = None
+    stream = None
+    cli = None
+
+    try:
+        for h in rig.hosts:
+            rig.spawn(h)
+        rig.spawn("net")
+
+        store = FileCoordStore(rig.coord)
+        client = PodClient(store=store, suspect_seconds=2.0)
+        deadline = time.time() + 180
+        while set(rig.hosts) - set(client.live_hosts()):
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"pod hosts never advertised: {client.live_hosts()}"
+                )
+            time.sleep(0.1)
+        cli = None
+        while cli is None:
+            try:
+                cli = SRClient("127.0.0.1", rig.port,
+                               reconnect_deadline_s=120.0)
+                cli.ping()
+            except Exception:  # noqa: BLE001 — net child still booting
+                cli = None
+                if time.time() > deadline:
+                    raise RuntimeError("net child never came up") from None
+                time.sleep(0.2)
+        say(f"rig up: hosts={list(rig.hosts)} net port={rig.port}")
+
+        # --- initial mix: pinned solos, a fleet-bait burst, net stream ------
+        seed_seq = iter(range(1, 10_000))
+        for h in rig.hosts:
+            pjid = client.submit(
+                JobSpec(X, y, options=_opts(next(seed_seq)), niterations=3),
+                host=h,
+            )
+            auditor.note_submit(pjid, niterations=3)
+        for _ in range(3):  # compatible burst: coalesces into a fleet
+            pjid = client.submit(
+                JobSpec(X, y, options=_opts(next(seed_seq)), niterations=2)
+            )
+            auditor.note_submit(pjid, niterations=2)
+        short_net = cli.submit(JobSpec(X, y, options=_opts(0), niterations=2))
+        long_id = cli.submit(JobSpec(X, y, options=_opts(0), niterations=25))
+        net_ids = [short_net, long_id]
+        for jid in net_ids:
+            auditor.note_submit(f"net/{jid}")
+        stream = cli.subscribe(long_id)
+
+        # --- soak loop ------------------------------------------------------
+        t0 = time.time()
+        submit_stop = t0 + 0.6 * duration_s
+        next_submit = t0 + 4.0
+        pod_jobs = 5
+        seen_done: set[str] = set()
+        while time.time() - t0 < duration_s:
+            now = time.time()
+            while kills and now - t0 >= kills[0]["at_s"]:
+                ev = kills.pop(0)
+                say(f"kill {ev['host']} at t+{now - t0:.1f}s "
+                    f"(down {ev['down_s']:.1f}s)")
+                rig.kill(ev["host"])
+                pending_respawn.append((now + ev["down_s"], ev["host"]))
+            for t_up, h in list(pending_respawn):
+                if now >= t_up:
+                    pending_respawn.remove((t_up, h))
+                    say(f"respawn {h}")
+                    rig.spawn(h)
+            if now >= next_submit and now < submit_stop and pod_jobs < 14:
+                next_submit = now + 4.0
+                pod_jobs += 1
+                pjid = client.submit(
+                    JobSpec(X, y, options=_opts(next(seed_seq)),
+                            niterations=2)
+                )
+                auditor.note_submit(pjid, niterations=2)
+            try:
+                for h, ad in client.hosts().items():
+                    auditor.observe_host_stats(h, ad)
+            except Exception:  # noqa: BLE001 — store mid-rotation
+                pass
+            try:
+                for pjid, rec in client.results().items():
+                    if pjid not in seen_done:
+                        seen_done.add(pjid)
+                        auditor.observe_done(pjid, rec)
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.25)
+
+        # any kill still pending past the soak window fires nothing; but a
+        # host killed and not yet respawned must come back for the drain
+        for _, h in pending_respawn:
+            say(f"respawn {h} (post-soak)")
+            rig.spawn(h)
+        pending_respawn.clear()
+
+        # --- drain: every accepted job must land in the done ledger ---------
+        drain_deadline = time.time() + max(240.0, 4 * duration_s)
+        say("drain: waiting for the done ledger to cover all submits")
+        while time.time() < drain_deadline:
+            try:
+                results = client.results()
+            except Exception:  # noqa: BLE001
+                time.sleep(0.5)
+                continue
+            for pjid, rec in results.items():
+                if pjid not in seen_done:
+                    seen_done.add(pjid)
+                    auditor.observe_done(pjid, rec)
+            if auditor._submitted - {f"net/{j}" for j in net_ids} <= set(
+                results
+            ):
+                break
+            time.sleep(0.5)
+        try:
+            for h, ad in client.hosts().items():
+                auditor.observe_host_stats(h, ad)
+        except Exception:  # noqa: BLE001
+            pass
+
+        # --- net drain + stream audit ---------------------------------------
+        # own budget: the pod drain above may have burned its whole deadline
+        # on a genuinely lost pod job, and that must not cascade into
+        # false "never finished" verdicts for healthy net jobs
+        net_deadline = time.time() + max(120.0, 2 * duration_s)
+        for jid in net_ids:
+            state = None
+            while time.time() < net_deadline:
+                try:
+                    summary = cli.terminal_summary(jid) or {}
+                    state = summary.get("state")
+                    if state is None:
+                        st2 = cli.status(jid)
+                        state = (
+                            st2["state"]
+                            if st2["state"] in
+                            ("done", "failed", "expired", "cancelled",
+                             "quarantined")
+                            else None
+                        )
+                    if state is not None:
+                        break
+                except Exception:  # noqa: BLE001 — reconnect window
+                    pass
+                time.sleep(0.5)
+            auditor.observe_done(
+                f"net/{jid}", {"state": state if state else "running"}
+            )
+        try:
+            stored = cli.frames(long_id, 0)
+            auditor.check_stream(
+                f"net/{long_id}", stream.dup_dropped, stream.next_index,
+                stored, stream.frames,
+            )
+        except Exception as e:  # noqa: BLE001
+            auditor._breach(
+                "frame_monotonic",
+                f"stream audit impossible (net unreachable at drain): {e!r}",
+            )
+        try:
+            cli.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+        # --- post-mortem: every journal generation must replay --------------
+        rig.teardown()  # SIGKILL everything first: no live writers
+        for jdir in sorted(glob.glob(os.path.join(rig.coord, "_pod", "*",
+                                                  "gen-*"))):
+            auditor.check_journal(jdir, context="pod gen")
+        if os.path.isdir(rig.net_journal):
+            auditor.check_journal(rig.net_journal, context="net journal")
+
+        auditor.finalize()
+        if not auditor.ok and verbose:
+            print(rig.tail_logs(), flush=True)
+        return auditor
+    except Exception:
+        if verbose:
+            print(rig.tail_logs(), flush=True)
+        raise
+    finally:
+        rig.teardown()
+
+
+def main(argv=None) -> int:
+    from symbolicregression_jl_tpu.utils import chaos
+    from symbolicregression_jl_tpu.utils.faults import FaultRule
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--hosts", type=int, default=2, choices=(2, 3))
+    ap.add_argument("--schedule", default=None,
+                    help="explicit schedule spec (overrides --seed)")
+    ap.add_argument("--emit-schedule", action="store_true",
+                    help="print the generated schedule spec and exit")
+    ap.add_argument("--break", dest="break_mode", default=None,
+                    choices=("shed_silently",),
+                    help="deliberately revert one degradation (demo: the "
+                         "auditor must catch it and the shrinker must "
+                         "minimize the schedule)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="on breach, skip delta-debugging the schedule")
+    ap.add_argument("--shrink-duration", type=float, default=25.0,
+                    help="soak seconds per shrink attempt")
+    ap.add_argument("--shrink-runs", type=int, default=12,
+                    help="max soak re-runs the shrinker may spend")
+    ap.add_argument("--workdir", default=None,
+                    help="keep rig state here instead of a temp dir")
+    ap.add_argument("--repro-out", default=None,
+                    help="write the (shrunk) failing schedule spec here")
+    args = ap.parse_args(argv)
+
+    host_names = tuple(f"h{i}" for i in range(args.hosts))
+    if args.schedule:
+        schedule = chaos.parse_schedule(args.schedule)
+    else:
+        schedule = chaos.generate_schedule(
+            args.seed, args.duration, hosts=host_names
+        )
+        if args.break_mode:
+            # the demo needs the shed window to hit a SUBMIT append
+            # deterministically: first journal append of h0 goes read-only
+            schedule = tuple(
+                FaultRule("disk_full", 0, (("clear", 1), ("host", "h0"),
+                                           ("path", "journal")))
+                if r.site == "disk_full" else r
+                for r in schedule
+            )
+    spec = chaos.schedule_spec(schedule)
+    print(f"CHAOS seed={args.seed} duration={args.duration:.0f}s "
+          f"hosts={args.hosts}\nSCHEDULE {spec}", flush=True)
+    if args.emit_schedule:
+        return 0
+
+    def soak_once(rules, duration, verbose) -> object:
+        if args.workdir:
+            os.makedirs(args.workdir, exist_ok=True)
+            run_dir = tempfile.mkdtemp(dir=args.workdir, prefix="run-")
+            return run_soak(rules, duration, run_dir, hosts=host_names,
+                            break_mode=args.break_mode, verbose=verbose)
+        with tempfile.TemporaryDirectory() as d:
+            return run_soak(rules, duration, d, hosts=host_names,
+                            break_mode=args.break_mode, verbose=verbose)
+
+    auditor = soak_once(schedule, args.duration, verbose=True)
+    print(auditor.report(), flush=True)
+    if auditor.ok:
+        print("CHAOS_SOAK=pass", flush=True)
+        return 0
+
+    target = auditor.breach_names()
+    minimal = schedule
+    if not args.no_shrink and len(schedule) > 1:
+        print(f"shrinking schedule against breaches {sorted(target)} "
+              f"({args.shrink_runs} runs x {args.shrink_duration:.0f}s max)",
+              flush=True)
+        budget = {"left": args.shrink_runs}
+
+        def failing(candidate) -> bool:
+            if budget["left"] <= 0:
+                return False  # budget exhausted: treat as non-failing
+            budget["left"] -= 1
+            try:
+                a = soak_once(candidate, args.shrink_duration, verbose=False)
+            except Exception as e:  # noqa: BLE001 — rig error != breach
+                print(f"  shrink run errored ({e!r}); treating as pass",
+                      flush=True)
+                return False
+            hit = bool(a.breach_names() & target)
+            print(f"  shrink: {len(candidate)} rule(s) -> "
+                  f"{'FAIL (kept)' if hit else 'pass (discarded)'}",
+                  flush=True)
+            return hit
+
+        minimal = chaos.ddmin(schedule, failing)
+    repro = chaos.schedule_spec(minimal)
+    out = args.repro_out or os.path.join(
+        args.workdir or tempfile.gettempdir(), "chaos_repro.txt"
+    )
+    with open(out, "w") as f:
+        f.write(
+            f"# chaos repro (seed={args.seed}, breaches="
+            f"{sorted(target)})\n"
+            f"# rerun: python scripts/chaos_soak.py --schedule '{repro}' "
+            f"--duration {args.shrink_duration:.0f}"
+            + (f" --break {args.break_mode}" if args.break_mode else "")
+            + "\n"
+            f"{repro}\n"
+        )
+    print(f"CHAOS_REPRO ({len(minimal)} rule(s)) {repro}\n"
+          f"repro written to {out}\nCHAOS_SOAK=fail", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(130)
+    except Exception as e:  # noqa: BLE001 — rig error, not a breach
+        print(f"CHAOS_SOAK=error {e!r}", flush=True)
+        raise SystemExit(2)

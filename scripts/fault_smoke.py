@@ -58,6 +58,7 @@ import subprocess
 import sys
 import tempfile
 import textwrap
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -958,23 +959,64 @@ def smoke_pod_federation() -> None:
     )
 
 
+# drill registry: (name, fn, invariants the drill pins). "all" mode runs
+# every drill, prints the summary table, and exits nonzero if ANY failed.
+_DRILLS = (
+    ("checkpoint", smoke_checkpoint_resume,
+     "resume bit-exact after preemption"),
+    ("exchange", smoke_degraded_exchange,
+     "partitioned exchange completes solo"),
+    ("elastic", smoke_elastic_rejoin,
+     "killed worker rejoins at later epoch"),
+    ("serve", smoke_serve_durability,
+     "journal recovery: zero lost/dup, bit-exact resume"),
+    ("net", smoke_net_front_door,
+     "reconnect across restart, exact frame replay"),
+    ("pod", smoke_pod_federation,
+     "migration: zero lost/dup, bit-exact lane resume"),
+)
+
+
+def _run_all(which: set) -> int:
+    rows = []
+    failed = False
+    for name, fn, invariant in _DRILLS:
+        if not (which & {"all", name}):
+            continue
+        if failed:  # first breach stops the run; the table still shows it
+            rows.append((name, invariant, "skip", 0.0, ""))
+            continue
+        t0 = time.time()
+        try:
+            fn()
+            verdict, detail = "pass", ""
+        except SystemExit as e:
+            failed = True
+            verdict, detail = "FAIL", str(e.code if e.code is not None else e)
+        except Exception as e:  # noqa: BLE001 — drill crash is a failure too
+            failed = True
+            verdict, detail = "FAIL", repr(e)
+        rows.append((name, invariant, verdict, time.time() - t0, detail))
+    w_name = max(len(r[0]) for r in rows)
+    w_inv = max(len(r[1]) for r in rows)
+    print("\n" + "=" * (w_name + w_inv + 18))
+    for name, invariant, verdict, dt, detail in rows:
+        print(f"{name:<{w_name}}  {invariant:<{w_inv}}  {verdict:<4} "
+              f"{dt:6.1f}s")
+        if detail:
+            print(f"{'':<{w_name}}  {detail}")
+    print("=" * (w_name + w_inv + 18))
+    if failed:
+        print("FAULT_SMOKE=fail")
+        return 1
+    print("FAULT_SMOKE=pass")
+    return 0
+
+
 if __name__ == "__main__":
     which = set(sys.argv[1:]) or {"all"}
-    unknown = which - {"all", "checkpoint", "exchange", "elastic", "serve",
-                       "net", "pod"}
+    unknown = which - ({"all"} | {name for name, _, _ in _DRILLS})
     if unknown:
         sys.exit(f"unknown cycle(s): {sorted(unknown)} "
-                 "(choose from: checkpoint exchange elastic serve net pod)")
-    if which & {"all", "checkpoint"}:
-        smoke_checkpoint_resume()
-    if which & {"all", "exchange"}:
-        smoke_degraded_exchange()
-    if which & {"all", "elastic"}:
-        smoke_elastic_rejoin()
-    if which & {"all", "serve"}:
-        smoke_serve_durability()
-    if which & {"all", "net"}:
-        smoke_net_front_door()
-    if which & {"all", "pod"}:
-        smoke_pod_federation()
-    print("FAULT_SMOKE=pass")
+                 "(choose from: " + " ".join(n for n, _, _ in _DRILLS) + ")")
+    sys.exit(_run_all(which))

@@ -7,7 +7,7 @@
 set -o pipefail
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
-TIMEOUT="${TIER1_TIMEOUT:-870}"
+TIMEOUT="${TIER1_TIMEOUT:-3000}"
 rm -f "$LOG"
 
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \

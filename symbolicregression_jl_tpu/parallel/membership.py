@@ -65,6 +65,7 @@ __all__ = [
     "CoordStore",
     "FileCoordStore",
     "JaxCoordStore",
+    "PartitionedCoordStore",
     "coord_store",
     "coord_gc_seconds",
     "elastic_enabled",
@@ -371,13 +372,137 @@ class JaxCoordStore(CoordStore):
         return sorted(out)
 
 
+class PartitionedCoordStore(CoordStore):
+    """Chaos wrapper: simulates a network partition between named host
+    groups by severing THIS process's view of keys that name hosts on the
+    far side, then healing.
+
+    Armed by the ``kv_partition`` fault site: the rule fires at the Nth
+    store operation, its ``block`` param is a ``|``-separated list of key
+    substrings to sever (host names, since pod ads/inboxes/claims embed
+    them), and ``ops`` (default 50) is the number of further store
+    operations after which the partition heals. While severed:
+
+    - ``try_get`` on a blocked key returns None, ``get`` raises
+      TimeoutError, ``list`` omits blocked keys — the far side's writes
+      are invisible, exactly as if its packets were dropped;
+    - ``set``/``set_mutable``/``delete`` on a blocked key are silently
+      dropped (the write never reaches the shared store);
+    - ``set_if_absent`` on a blocked key returns False WITHOUT writing —
+      a CAS with no connectivity cannot win. Pod adoption claims for a
+      partitioned host simply retry next scan; the write-once done ledger
+      keys carry job ids, not host names, so exactly-once result
+      publication is never forged by the wrapper itself.
+
+    Everything else delegates to the wrapped store (including attribute
+    access — ``.root``, ``.gc`` — so rig plumbing built for
+    :class:`FileCoordStore` keeps working). The healed/dropped counters
+    feed the chaos auditor through :meth:`partition_stats`."""
+
+    def __init__(self, inner: CoordStore):
+        self.inner = inner
+        self._plock = threading.Lock()
+        self._blocked: tuple[str, ...] = ()
+        self._ops_left = 0
+        self._partitions = 0
+        self._healed = 0
+        self._dropped_ops = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _severed(self, key: str) -> bool:
+        from ..utils import faults
+
+        inj = faults.active()
+        with self._plock:
+            if inj.armed("kv_partition"):
+                hit = inj.fire("kv_partition")
+                if hit is not None:
+                    block = str(hit.get("block", ""))
+                    self._blocked = tuple(b for b in block.split("|") if b)
+                    self._ops_left = max(1, int(hit.get("ops", 50)))
+                    if self._blocked:
+                        self._partitions += 1
+            if not self._blocked:
+                return False
+            self._ops_left -= 1
+            if self._ops_left <= 0:
+                self._blocked = ()
+                self._healed += 1
+                return False
+            if any(b in key for b in self._blocked):
+                self._dropped_ops += 1
+                return True
+            return False
+
+    def partition_stats(self) -> dict:
+        with self._plock:
+            return {
+                "active": bool(self._blocked),
+                "blocked": list(self._blocked),
+                "partitions": self._partitions,
+                "healed": self._healed,
+                "dropped_ops": self._dropped_ops,
+            }
+
+    # -- CoordStore surface, each op consulting the partition state ----------
+    def set(self, key: str, value: bytes) -> None:
+        if not self._severed(key):
+            self.inner.set(key, value)
+
+    def set_mutable(self, key: str, value: bytes) -> None:
+        if not self._severed(key):
+            self.inner.set_mutable(key, value)
+
+    def set_if_absent(self, key: str, value: bytes) -> bool:
+        if self._severed(key):
+            return False
+        return self.inner.set_if_absent(key, value)
+
+    def get(self, key: str, timeout_ms: int) -> bytes:
+        if self._severed(key):
+            raise TimeoutError(
+                f"kv_partition: {key!r} unreachable (injected partition)"
+            )
+        return self.inner.get(key, timeout_ms)
+
+    def try_get(self, key: str) -> bytes | None:
+        if self._severed(key):
+            return None
+        return self.inner.try_get(key)
+
+    def delete(self, key: str) -> None:
+        if not self._severed(key):
+            self.inner.delete(key)
+
+    def list(self, prefix: str) -> list[str]:
+        if self._severed(prefix):
+            return []
+        keys = self.inner.list(prefix)
+        with self._plock:
+            blocked = self._blocked
+        if blocked:
+            keys = [k for k in keys if not any(b in k for b in blocked)]
+        return keys
+
+    def barrier(self, bid: str, timeout_ms: int, ids, my_id: int) -> None:
+        self.inner.barrier(bid, timeout_ms, ids, my_id)
+
+
 def coord_store() -> CoordStore:
     """The active transport: ``SR_COORD_DIR`` selects the file store (the
-    restart-capable rig); otherwise the jax.distributed KV store."""
+    restart-capable rig); otherwise the jax.distributed KV store. When the
+    active fault injector arms ``kv_partition``, the store is wrapped in a
+    :class:`PartitionedCoordStore` so every consumer in this process — pod
+    node, pod client, exchange group — shares one partition view."""
     root = os.environ.get("SR_COORD_DIR")
-    if root:
-        return FileCoordStore(root)
-    return JaxCoordStore()
+    store: CoordStore = FileCoordStore(root) if root else JaxCoordStore()
+    from ..utils import faults
+
+    if faults.active().armed("kv_partition"):
+        store = PartitionedCoordStore(store)
+    return store
 
 
 def elastic_enabled(options=None) -> bool:

@@ -37,10 +37,27 @@ I/O on the undurable hot path.
 
 The ``journal_torn_write`` fault site (``utils/faults.py``) deterministically
 produces a half-written frame for the torn-tail drills.
+
+**Disk-full degradation (r19):** an ``ENOSPC`` from the append path — real,
+or injected via the ``disk_full`` fault site — must not crash the worker
+that happened to hold the pen. The journal instead (1) attempts an
+emergency compaction (rotation drops terminal tombstones — on a genuinely
+full disk this is the only write that can *shrink* the footprint), (2)
+retries the append once, and (3) on a second failure enters **read-only
+shedding mode**: ``submit`` records raise :class:`JournalDiskFull` (the
+server surfaces it as ``ServerOverloaded`` with a retry-after hint — a job
+whose submit cannot be made durable is refused, not silently undurable),
+while records for jobs ALREADY running (start/progress/requeue/terminal)
+are buffered in memory (bounded) and the jobs keep running. Every later
+append probes the disk; the first success **re-arms** the journal, flushing
+the buffered records in order before the probe record. A crash while
+read-only loses only the buffered records — never a committed frame, and
+never a submit (those were shed, so the client knows to resubmit).
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import pickle
 import struct
@@ -48,7 +65,11 @@ import threading
 import time
 import zlib
 
-__all__ = ["JobJournal", "JOURNAL_MAGIC"]
+__all__ = ["JobJournal", "JournalDiskFull", "JOURNAL_MAGIC"]
+
+# read-only mode buffers at most this many records for running jobs; past it,
+# progress records are dropped first (they are informational), then oldest
+_PENDING_MAX = 4096
 
 JOURNAL_MAGIC = b"SRJRNL01"
 _HDR = struct.Struct("<II")  # payload length, crc32(payload)
@@ -61,6 +82,15 @@ def _journal_max_bytes() -> int:
     except ValueError:
         mb = 64.0
     return int(mb * (1 << 20))
+
+
+class JournalDiskFull(OSError):
+    """A ``submit`` append was shed because the journal is in read-only
+    (disk-full) mode: the job was NOT made durable and must be resubmitted
+    once space returns. The server maps this to ``ServerOverloaded``."""
+
+    def __init__(self, msg: str):
+        super().__init__(errno.ENOSPC, msg)
 
 
 def _fresh_state(job_id: str) -> dict:
@@ -107,6 +137,15 @@ class JobJournal:
         self._rotations = 0
         self._torn_bytes = 0
         self._undurable = 0
+        # -- disk-full degradation state (r19) --
+        self._read_only = False
+        self._pending: list[tuple[bytes, dict, bool]] = []  # (frame, rec, fsync)
+        self._enospc_events = 0
+        self._emergency_compactions = 0
+        self._rearms = 0
+        self._shed_submits = 0
+        self._dropped_buffered = 0
+        self._simulated_enospc = 0  # injected: this many appends still see ENOSPC
 
     # -- record merge ---------------------------------------------------------
     def _merge(self, rec: dict) -> None:
@@ -200,7 +239,9 @@ class JobJournal:
     # -- append ---------------------------------------------------------------
     def append(self, type_: str, job_id: str, fsync: bool = True, **fields) -> None:
         """Append one record. ``fsync=False`` (progress heartbeats) flushes
-        to the OS but skips the disk barrier."""
+        to the OS but skips the disk barrier. ENOSPC degrades instead of
+        propagating: see the module docstring (raises :class:`JournalDiskFull`
+        only for shed ``submit`` records)."""
         from ..utils import faults
 
         rec = {"type": type_, "job": job_id, "t": time.time(), **fields}
@@ -209,7 +250,8 @@ class JobJournal:
         with self._lock:
             if self._fh is None:
                 self._open_append()
-            hit = faults.active().fire("journal_torn_write")
+            inj = faults.active()
+            hit = inj.fire("journal_torn_write")
             if hit is not None:
                 # half a frame, flushed: exactly the crash-mid-append tail
                 cut = max(1, len(frame) // 2)
@@ -217,14 +259,111 @@ class JobJournal:
                 self._fh.flush()
                 os.fsync(self._fh.fileno())
                 raise faults.FaultInjected("injected journal_torn_write")
-            self._fh.write(frame)
-            self._fh.flush()
-            if fsync and self.fsync:
-                os.fsync(self._fh.fileno())
+            if inj.armed("disk_full"):
+                df = inj.fire("disk_full")
+                if df is not None and str(df.get("path", "both")) in (
+                    "journal", "both",
+                ):
+                    # this append plus the next `clear` see a full disk
+                    self._simulated_enospc = 1 + max(0, int(df.get("clear", 1)))
+            try:
+                self._write_frame_locked(frame, fsync)
+            except OSError as exc:
+                if exc.errno != errno.ENOSPC:
+                    raise
+                self._enospc_locked(rec, frame, fsync, exc)
+                return
+            if self._read_only:
+                # the probe write succeeded: space is back — re-arm, flushing
+                # the records buffered for running jobs (they precede the
+                # probe in the file because _write_frame_locked drains them
+                # first; reaching here means the whole drain committed)
+                self._read_only = False
+                self._rearms += 1
             self._merge(rec)
             self._appended += 1
             if self.max_bytes and self._fh.tell() > self.max_bytes:
                 self._rotate_locked()
+
+    def _write_one_locked(self, frame: bytes, fsync: bool) -> None:
+        """Write exactly one frame. On ENOSPC — injected or real — truncate
+        back to the pre-write offset so a PARTIAL frame never poisons the
+        tail (later successful appends would land after it and be lost to
+        replay's torn-tail truncation), then re-raise."""
+        if self._simulated_enospc > 0:
+            self._simulated_enospc -= 1
+            raise OSError(errno.ENOSPC, "No space left on device (injected)")
+        pos = self._fh.tell()
+        try:
+            self._fh.write(frame)
+            self._fh.flush()
+            if fsync and self.fsync:
+                os.fsync(self._fh.fileno())
+        except OSError:
+            try:
+                self._fh.truncate(pos)
+            except OSError:
+                pass
+            raise
+
+    def _write_frame_locked(self, frame: bytes, fsync: bool) -> None:
+        """Write one frame, draining any read-only buffer first (oldest
+        first, so replay order matches append order). Raises OSError(ENOSPC)
+        without touching merged state."""
+        while self._pending:
+            pframe, _prec, pfsync = self._pending[0]
+            self._write_one_locked(pframe, pfsync)
+            self._pending.pop(0)
+            self._appended += 1
+        self._write_one_locked(frame, fsync)
+
+    def _enospc_locked(self, rec, frame, fsync, exc) -> None:
+        """Degrade on a full disk: emergency-compact once, retry, then shed
+        submits / buffer running-job records. Never propagates ENOSPC for
+        non-submit records — the job keeps running undurably."""
+        self._enospc_events += 1
+        first = not self._read_only
+        self._read_only = True
+        if first:
+            # emergency compaction: tombstones are the only mass we can shed
+            # without losing live state; on a real full disk the tmp-file
+            # write may itself fail — that's fine, stay read-only
+            try:
+                self._rotate_locked()
+                self._emergency_compactions += 1
+            except OSError:
+                pass
+            # one immediate retry: compaction may have freed enough
+            try:
+                self._write_frame_locked(frame, fsync)
+            except OSError as exc2:
+                if exc2.errno != errno.ENOSPC:
+                    raise
+            else:
+                self._read_only = False
+                self._rearms += 1
+                self._merge(rec)
+                self._appended += 1
+                return
+        if rec.get("type") == "submit":
+            # durability IS the submit contract: refuse rather than accept a
+            # job that would vanish on crash
+            self._shed_submits += 1
+            raise JournalDiskFull(
+                f"journal read-only (disk full): submit {rec.get('job')!r} "
+                f"shed after {self._enospc_events} ENOSPC events"
+            ) from exc
+        # running jobs keep going: buffer (bounded, progress dropped first)
+        if len(self._pending) >= _PENDING_MAX:
+            idx = next(
+                (i for i, (_, r, _) in enumerate(self._pending)
+                 if r.get("type") == "progress"),
+                0,
+            )
+            self._pending.pop(idx)
+            self._dropped_buffered += 1
+        self._pending.append((frame, rec, fsync))
+        self._merge(rec)
 
     def append_submit(self, job) -> bool:
         """Journal a submit, pickling the JobSpec so a restarted server can
@@ -310,6 +449,13 @@ class JobJournal:
         with self._lock:
             self._close()
 
+    @property
+    def read_only(self) -> bool:
+        """Disk-full shedding mode: submits are refused until a probe append
+        succeeds (the server's submit() turns this into ServerOverloaded)."""
+        with self._lock:
+            return self._read_only
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -319,4 +465,11 @@ class JobJournal:
                 "rotations": self._rotations,
                 "torn_bytes_truncated": self._torn_bytes,
                 "undurable_specs": self._undurable,
+                "read_only": self._read_only,
+                "enospc_events": self._enospc_events,
+                "emergency_compactions": self._emergency_compactions,
+                "rearms": self._rearms,
+                "shed_submits": self._shed_submits,
+                "buffered_records": len(self._pending),
+                "dropped_buffered": self._dropped_buffered,
             }

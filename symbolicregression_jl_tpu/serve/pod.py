@@ -69,6 +69,7 @@ import uuid
 
 from ..parallel.distributed import kv_backoff_max_ms, kv_backoff_ms
 from ..parallel.membership import CoordStore, FileCoordStore, coord_store
+from ..utils import faults
 from . import queue as q
 from .queue import JobSpec, ServerOverloaded, bucket_digest, shape_bucket
 from .server import SearchServer
@@ -172,8 +173,11 @@ class PodNode:
         self.keys = _PodKeys(pod_id or pod_id_env())
         root = root or os.environ.get("SR_POD_ROOT") or None
         if root is None:
-            if isinstance(self.store, FileCoordStore):
-                root = os.path.join(self.store.root, "_pod")
+            # unwrap fault-injection decorators (PartitionedCoordStore):
+            # the journal root lives on the real file-backed store
+            inner = getattr(self.store, "inner", self.store)
+            if isinstance(inner, FileCoordStore):
+                root = os.path.join(inner.root, "_pod")
             else:
                 raise ValueError(
                     "PodNode needs a shared journal root: pass root= or set "
@@ -207,6 +211,12 @@ class PodNode:
         self._adopted_jobs = 0
         self._adopted_hosts = 0
         self._duplicate_results = 0
+        self._skew_suppressed = 0  # suspicions vetoed by ad-stamp progress
+        self._last_peer_ad_t: dict[str, float] = {}  # host -> last seen stamp
+        # host -> monotonic() when its ad stamp was first seen frozen; a
+        # stale-looking peer is only adopted after staying frozen for a
+        # full suspect window (see _scan_peers' clock-skew discipline)
+        self._frozen_since: dict[str, float] = {}
 
     # -- generations -----------------------------------------------------------
     def _host_dir(self, host: str) -> str:
@@ -305,7 +315,10 @@ class PodNode:
             stats = {"queued": s["queued"], "running": s["running"]}
         ad = {
             "host": self.host_id,
-            "t": time.time(),
+            # the skewable clock source: a clock_skew rule shifts THIS
+            # host's stamps while honest peers keep real time — exactly the
+            # failure the scan-side progress veto must absorb
+            "t": faults.skewed_time(self.host_id),
             "gen": self.gen,
             "pid": os.getpid(),
             "queue_depth": stats["queued"],
@@ -315,6 +328,7 @@ class PodNode:
             "adopted_jobs": self._adopted_jobs,
             "adopted_hosts": self._adopted_hosts,
             "duplicate_results": self._duplicate_results,
+            "skew_suspects_suppressed": self._skew_suppressed,
         }
         try:
             self.store.set_mutable(
@@ -428,7 +442,7 @@ class PodNode:
 
     # -- peer adoption ---------------------------------------------------------
     def _scan_peers(self) -> None:
-        now = time.time()
+        now = faults.skewed_time(self.host_id)
         for key in self.store.list(self.keys.ad_prefix()):
             host = key.rsplit("/", 1)[-1]
             if host == self.host_id:
@@ -444,7 +458,40 @@ class PodNode:
             retired = (
                 self.store.try_get(self.keys.retire(host, gen)) is not None
             )
-            stale = now - float(ad.get("t", 0.0)) > self.suspect_s
+            ad_t = float(ad.get("t", 0.0))
+            stale = now - ad_t > self.suspect_s
+            if not retired and stale:
+                # clock-skew veto: an ad can look ancient because OUR clock
+                # (or the peer's) is skewed, not because the peer died. A
+                # dead host's stamp FREEZES — so if the stamp advanced since
+                # the last scan, the host is provably still publishing and
+                # suspicion is suppressed. Absolute age alone never migrates
+                # lanes away from a live, heartbeating host.
+                prev = self._last_peer_ad_t.get(host)
+                self._last_peer_ad_t[host] = ad_t
+                if prev is None or ad_t > prev:
+                    # advanced (or first sight): alive, or not yet observed
+                    # long enough to judge — start/restart the freeze clock
+                    self._frozen_since.pop(host, None)
+                    if prev is not None:
+                        with self._lock:
+                            self._skew_suppressed += 1
+                    continue
+                # stamp frozen across scans. One missed beat must NOT
+                # migrate lanes away from a live host whose publish jitter
+                # straddled two of our scans (with our clock skewed, the
+                # absolute age is garbage and this pair-compare is ALL the
+                # evidence there is) — so require the stamp to stay frozen
+                # for a full suspect window of LOCAL MONOTONIC time, the
+                # same no-heartbeat window the honest-clock path demands.
+                t_frozen = self._frozen_since.setdefault(
+                    host, time.monotonic()
+                )
+                if time.monotonic() - t_frozen < self.suspect_s:
+                    continue
+            else:
+                self._last_peer_ad_t[host] = ad_t
+                self._frozen_since.pop(host, None)
             if not retired and not stale:
                 continue
             claim_key = self.keys.claim(host, gen)
@@ -454,21 +501,24 @@ class PodNode:
             if not self.store.set_if_absent(claim_key, pickle.dumps(lease)):
                 continue  # another survivor won the lease
             if not retired:
-                # liveness re-check after the claim: if the host republished
-                # its ad since we read it, it rebooted — back off
+                # liveness re-check after the claim: ANY stamp advance since
+                # we started suspecting proves a live publisher (a dead
+                # host's stamp cannot move) — back off and release. No
+                # absolute-age clause here: with our clock skewed, a live
+                # host's fresh ad still looks ancient, and the old
+                # age-qualified check waved exactly those adoptions through.
                 raw2 = self.store.try_get(key)
                 if raw2 is not None:
                     try:
                         ad2 = pickle.loads(raw2)
-                        if (
-                            float(ad2.get("t", 0.0)) > float(ad.get("t", 0.0))
-                            and now - float(ad2.get("t", 0.0)) <= self.suspect_s
-                        ):
+                        if float(ad2.get("t", 0.0)) > ad_t:
                             self.store.delete(claim_key)
+                            self._frozen_since.pop(host, None)
                             continue
                     except Exception:  # noqa: BLE001
                         pass
             self._adopt_host(host, gen, retired=retired)
+            self._frozen_since.pop(host, None)
             self.store.delete(key)  # off the routing table
 
     def _adopt_host(self, host: str, gen: int, retired: bool) -> None:
@@ -647,6 +697,7 @@ class PodNode:
                 "adopted_jobs": self._adopted_jobs,
                 "adopted_hosts": self._adopted_hosts,
                 "duplicate_results": self._duplicate_results,
+                "skew_suspects_suppressed": self._skew_suppressed,
             }
         if self.server is not None:
             out["server"] = self.server.stats()

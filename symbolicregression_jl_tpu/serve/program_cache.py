@@ -53,10 +53,26 @@ __all__ = [
     "ProgramCache",
     "global_program_cache",
     "enable_persistent_compilation_cache",
+    "is_oom_error",
 ]
 
 _DEFAULT_CAPACITY = 64  # program entries (score fns + AOT executables)
 _DEFAULT_DATA_MB = 256  # ScoreData device-array budget
+
+# cache kinds whose miss means "an XLA compile is about to run" — the
+# ``oom_compile`` fault site counts ONLY these misses, so a rule's call
+# count addresses the Nth compile, not the Nth lookup of anything
+_COMPILE_KINDS = frozenset({"aot", "fleet_aot", "fleet_rb"})
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Does this exception mean the accelerator ran out of memory building
+    or running a program? Matches real ``XlaRuntimeError`` texts and the
+    injected :class:`~..utils.faults.ResourceExhaustedInjected` with one
+    predicate — the serve layer's downshift logic keys off this, so the
+    simulation exercises exactly the production path."""
+    text = str(exc)
+    return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text
 
 
 def _env_int(name: str, default: int) -> int:
@@ -113,10 +129,27 @@ class ProgramCache:
             ent = self._entries.get(k)
             if ent is None:
                 self._misses[kind] = self._misses.get(kind, 0) + 1
-                return None
-            self._entries[k] = self._entries.pop(k)  # refresh to MRU
-            self._hits[kind] = self._hits.get(kind, 0) + 1
-            return ent[0]
+            else:
+                self._entries[k] = self._entries.pop(k)  # refresh to MRU
+                self._hits[kind] = self._hits.get(kind, 0) + 1
+                return ent[0]
+        # miss on a compile kind: the caller is about to lower().compile().
+        # The oom_compile fault fires HERE (outside the lock — a real compile
+        # OOM would raise outside it too) so every AOT build site inherits
+        # the injection without its own hook. A rule's `kind` param restricts
+        # it to one artifact class (e.g. kind=fleet_aot); the call count is
+        # consumed either way, keeping schedules deterministic.
+        if kind in _COMPILE_KINDS:
+            from ..utils import faults
+
+            inj = faults.active()
+            if inj.armed("oom_compile"):
+                hit = inj.fire("oom_compile")
+                if hit is not None and (
+                    "kind" not in hit or str(hit["kind"]) == kind
+                ):
+                    raise faults.ResourceExhaustedInjected(kind, key)
+        return None
 
     def put(self, kind: str, key, value, nbytes: int = 0):
         """Insert with setdefault semantics: if another thread won the build

@@ -74,7 +74,12 @@ import time
 import traceback as _tbmod
 
 from . import queue as q
-from .program_cache import enable_persistent_compilation_cache, global_program_cache
+from .program_cache import (
+    enable_persistent_compilation_cache,
+    global_program_cache,
+    is_oom_error,
+)
+from .journal import JournalDiskFull
 from .queue import Job, JobQueue, JobSpec, ServerOverloaded
 
 __all__ = ["SearchServer", "JobSpec", "ServerOverloaded"]
@@ -225,6 +230,12 @@ class SearchServer:
         self._shed = 0
         self._stalls = 0
         self._worker_restarts = 0
+        # -- chaos-degradation telemetry (r19) --
+        self._journal_shed = 0  # submits refused while the journal is read-only
+        self._oom_downshifts = 0  # fleet batches halved/solo'd on compile OOM
+        self._skew_suppressed = 0  # stall-watchdog passes suppressed on a
+        #                            wall-clock jump (skew/NTP step)
+        self._watch_clock = None  # (wall, monotonic) of the last watchdog pass
         self._admission_paused = threading.Event()
         self._recovered = {
             "queued": 0, "running": 0, "resumed": 0, "terminal": 0,
@@ -557,6 +568,26 @@ class SearchServer:
         if self.journal is not None:
             try:
                 self.journal.append_submit(job)
+            except JournalDiskFull as exc:
+                # disk-full shedding: a submit that cannot be made durable is
+                # refused (the append itself is the probe — the first submit
+                # after space returns re-arms the journal and is accepted).
+                # Running jobs are untouched; the client retries later.
+                if os.environ.get("SR_CHAOS_BREAK") == "shed_silently":
+                    # chaos-demo regression (scripts/chaos_soak.py --break):
+                    # accept the job id but drop the job — the auditor's
+                    # no_lost_jobs invariant must catch this
+                    with self._lock:
+                        self._jobs.pop(job_id, None)
+                    return job_id
+                with self._lock:
+                    self._jobs.pop(job_id, None)
+                    self._shed += 1
+                    self._journal_shed += 1
+                raise ServerOverloaded(
+                    "journal is read-only (disk full); resubmit after "
+                    f"retry-after={max(1.0, self.poll_seconds * 5):.1f}s"
+                ) from exc
             except Exception:
                 try:
                     self.journal.replay()
@@ -710,6 +741,12 @@ class SearchServer:
                 "shed": self._shed,
                 "stalls": self._stalls,
                 "worker_restarts": self._worker_restarts,
+                # -- degradation states (r19): the chaos auditor reads these
+                #    from here, never from private attributes --
+                "journal_read_only": bool(journal.get("read_only", False)),
+                "journal_shed": self._journal_shed,
+                "oom_downshifts": self._oom_downshifts,
+                "skew_suspects_suppressed": self._skew_suppressed,
                 "journal": journal,
                 "program_cache": cache,
                 "warm_hit_ratio": cache["hit_ratio"],
@@ -813,9 +850,30 @@ class SearchServer:
                     with self._lock:
                         self._worker_restarts += 1
             if self.stall_s > 0:
-                now = time.time()
+                from ..utils import faults
+
+                # the watchdog reads the wall clock through the skewable
+                # source: an injected (or real NTP-step) clock jump shows up
+                # as wall time advancing far faster than the monotonic clock
+                # between passes — in that window heartbeat ages are garbage,
+                # so re-stamp them instead of stall-killing healthy runs
+                now = faults.skewed_time(os.environ.get("SR_POD_HOST"))
+                mono = time.monotonic()
+                jumped = False
+                if self._watch_clock is not None:
+                    wall_d = now - self._watch_clock[0]
+                    mono_d = mono - self._watch_clock[1]
+                    jumped = abs(wall_d - mono_d) > max(1.0, 0.5 * self.stall_s)
+                self._watch_clock = (now, mono)
                 with self._lock:
                     running = list(self._running.values())
+                if jumped:
+                    with self._lock:
+                        self._skew_suppressed += 1
+                    for job in running:
+                        if job.heartbeat is not None:
+                            job.heartbeat = now
+                    continue
                 for job in running:
                     hb = job.heartbeat
                     if (
@@ -847,7 +905,10 @@ class SearchServer:
         def _on_iteration(report) -> bool | None:
             from ..utils import faults
 
-            job.heartbeat = time.time()
+            # stamped through the skewable clock so heartbeat and watchdog
+            # agree once an injected skew latches (the jump itself is what
+            # the watchdog's monotonic cross-check absorbs)
+            job.heartbeat = faults.skewed_time(os.environ.get("SR_POD_HOST"))
             job.iterations_done = job.iteration_base + report.iteration
             user_stop = user_cb(report) if user_cb is not None else None
             hit = faults.active().fire("stall")
@@ -1246,7 +1307,6 @@ class SearchServer:
         leaves the fleet early while the surviving lanes drain unchanged.
         A batch that collapses to ONE unique search skips the fleet program
         entirely and runs the warm solo path."""
-        from ..models.device_search import FleetLaneSpec, fleet_search
         from ..utils.checkpoint import options_fingerprint
 
         grouped: dict = {}
@@ -1276,6 +1336,20 @@ class SearchServer:
             self._run_job(leader, group=jobs)
             self._fan_out(leader, followers, fp)
             return
+
+        self._run_fleet_groups(groups, now)
+
+    def _run_fleet_groups(self, groups: list, now: float) -> None:
+        """Run unique-content groups as one fleet program, degrading on
+        compile OOM: a ``RESOURCE_EXHAUSTED`` from the batch (real, or the
+        injected ``oom_compile`` site) halves the lane set and retries each
+        half; a single group that still OOMs at fleet width falls back to
+        the warm SOLO path (a strictly smaller program). Jobs consume no
+        retry attempt for the downshift itself — quarantine is reached only
+        if the solo run fails too. Non-OOM failures keep the r15 isolation:
+        every incomplete member retries solo with ``solo_only``."""
+        from ..models.device_search import FleetLaneSpec, fleet_search
+        from ..utils.checkpoint import options_fingerprint
 
         leaders = [g[0] for g in groups]
         specs, fingerprints = [], []
@@ -1308,12 +1382,35 @@ class SearchServer:
                 lane_bucket=self.fleet_max,
             )
         except BaseException as e:
+            pending = [
+                g for flag, g in zip(completed, groups) if not flag
+            ]
+            if is_oom_error(e) and not self._stopping:
+                with self._lock:
+                    self._oom_downshifts += 1
+                if len(pending) > 1:
+                    # halve the batch: smaller lane counts compile smaller
+                    # programs — each half re-enters this path and can halve
+                    # again until it fits (or collapses to the solo leg)
+                    mid = (len(pending) + 1) // 2
+                    for half in (pending[:mid], pending[mid:]):
+                        if half:
+                            self._run_fleet_groups(half, now)
+                    return
+                for group in pending:
+                    leader = group[0]
+                    fp = options_fingerprint(leader.spec.options)
+                    try:
+                        self._run_job(leader, group=group)
+                        self._fan_out(leader, group[1:], fp)
+                    except BaseException as e2:
+                        for job in group:
+                            self._handle_run_failure(job, e2, solo_retry=True)
+                return
             # fleet failure isolation: an exception in the coalesced batch
             # must not FAIL every incomplete lane — each member retries solo
             # (solo_only, so it never re-enters a coalesced batch)
-            for flag, group in zip(completed, groups):
-                if flag:
-                    continue
+            for group in pending:
                 for job in group:
                     self._handle_run_failure(job, e, solo_retry=True)
 

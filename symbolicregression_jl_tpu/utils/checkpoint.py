@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import csv
 import dataclasses
+import errno as _errno
 import json
 import os
 import pickle
@@ -740,6 +741,8 @@ class SearchCheckpointer:
         self.keep = max(1, int(keep))
         self._last_time = time.time()
         self._last_iter_saved = -1
+        self.enospc_skipped = 0  # snapshots skipped on a full disk (previous
+        #                          snapshot intact — the degradation contract)
         existing = _list_snapshots(base)
         self._seq = existing[-1][0] + 1 if existing else 0
 
@@ -782,11 +785,40 @@ class SearchCheckpointer:
         data = dump_checkpoint_bytes(ckpt)
         path = f"{self.base}.{self._seq:06d}"
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        hit = faults.active().fire("ckpt_crash")
+        inj = faults.active()
+        try:
+            if inj.armed("disk_full"):
+                df = inj.fire("disk_full")
+                if df is not None and str(df.get("path", "both")) in (
+                    "ckpt", "both",
+                ):
+                    raise OSError(
+                        _errno.ENOSPC, "No space left on device (injected)"
+                    )
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as exc:
+            if exc.errno != _errno.ENOSPC:
+                raise
+            # disk full mid-snapshot: the atomic-rename discipline means the
+            # PREVIOUS snapshot is still intact and loadable — drop the tmp
+            # orphan, log, and keep searching undurably rather than killing
+            # a healthy run over a full scratch disk
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            self.enospc_skipped += 1
+            print(
+                f"[checkpoint] ENOSPC writing {path}: keeping previous "
+                f"snapshot, search continues ({self.enospc_skipped} skipped)",
+                flush=True,
+            )
+            snaps = _list_snapshots(self.base)
+            return snaps[-1][1] if snaps else ""
+        hit = inj.fire("ckpt_crash")
         if hit is not None:
             # kill-after-tmp-write: the torn-write window the atomic rename
             # exists to close — the tmp orphan stays, the promote never runs

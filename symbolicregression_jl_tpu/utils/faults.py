@@ -67,11 +67,37 @@ Fault sites (each scheduler documents which it consults):
   frame (flushed) and aborts the connection — the network analogue of
   ``journal_torn_write``. The client codec must discard the torn tail on
   reconnect and the index-based resume must replay exactly.
+- ``disk_full`` — an ``OSError(ENOSPC)`` raised from a durable write path
+  (param ``path``: ``journal`` fires in ``JobJournal.append``, ``ckpt`` in
+  ``SearchCheckpointer.save``; default fires at both). The journal must
+  degrade to read-only shedding (``ServerOverloaded`` with retry-after,
+  running jobs unaffected) and re-arm when space returns (param ``clear``:
+  appends until the condition clears, default 1); a checkpoint ENOSPC must
+  keep the previous snapshot intact — the tmp write dies, the promote
+  never runs.
+- ``oom_compile`` — a simulated ``RESOURCE_EXHAUSTED`` compile failure
+  (:class:`ResourceExhaustedInjected`) raised at a program-cache build
+  (param ``kind``: restrict to one cache kind, e.g. ``fleet_aot``). The
+  serve fleet path must downshift — halve the lane batch, then fall back
+  to solo — before quarantining anything.
+- ``clock_skew`` — a per-host wall-clock offset (param ``offset_s``,
+  default 120; param ``host``: restrict to one pod host) applied by
+  :func:`skewed_time` to pod heartbeat/suspect stamps and the serve stall
+  watchdog. Peers must suppress suspicion of hosts whose ads are merely
+  skewed (stamped in the future) rather than stale.
+- ``kv_partition`` — the CoordStore wrapper starts dropping reads/writes
+  between named host groups (param ``block``: ``|``-separated substrings
+  of keys to sever; param ``ops``: heal after that many further store
+  operations, default 50), then heals. After heal the pod must converge
+  with zero duplicate results via the write-once done ledger.
 
 One injector is active per process at a time: ``install()`` (called by the
 schedulers when ``Options.fault_spec`` is set, resetting call counts) takes
-precedence over the lazily-built ``SR_FAULT_SPEC`` env injector used by
-subprocess rigs, where process-lifetime counting is the right semantics.
+precedence over the ``SR_FAULT_SPEC`` env injector used by subprocess rigs,
+where process-lifetime counting is the right semantics. The env injector is
+rebuilt whenever the env var's value changes (tests that set/unset
+``SR_FAULT_SPEC`` after the first ``active()`` call are honored), and
+``reset_env_injector()`` drops it explicitly.
 """
 
 from __future__ import annotations
@@ -84,11 +110,15 @@ __all__ = [
     "FAULT_SITES",
     "FaultInjected",
     "CheckpointWriteCrash",
+    "ResourceExhaustedInjected",
     "FaultRule",
     "FaultInjector",
     "parse_fault_spec",
+    "format_fault_spec",
     "install",
     "active",
+    "reset_env_injector",
+    "skewed_time",
 ]
 
 FAULT_SITES = (
@@ -106,6 +136,10 @@ FAULT_SITES = (
     "net_drop",
     "slow_client",
     "torn_frame",
+    "disk_full",
+    "oom_compile",
+    "clock_skew",
+    "kv_partition",
 )
 
 
@@ -116,6 +150,19 @@ class FaultInjected(RuntimeError):
 class CheckpointWriteCrash(FaultInjected):
     """Injected ``ckpt_crash``: the snapshot's tmp file was written and
     fsynced, but the atomic promote never ran."""
+
+
+class ResourceExhaustedInjected(FaultInjected):
+    """Injected ``oom_compile``: a program-cache build failed the way XLA
+    reports HBM exhaustion. The message carries the jaxlib marker string so
+    the serve layer's OOM classifier matches real ``XlaRuntimeError``\\ s and
+    this simulation with one predicate."""
+
+    def __init__(self, kind: str, key: object):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected compile OOM at program-cache "
+            f"build kind={kind!r} key={key!r}"
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,9 +183,15 @@ def _coerce(value: str):
         return value
 
 
-def parse_fault_spec(spec: str) -> tuple[FaultRule, ...]:
+def parse_fault_spec(
+    spec: str, extra_sites: tuple[str, ...] = ()
+) -> tuple[FaultRule, ...]:
     """Parse the spec grammar above; raises ValueError on malformed input
-    (Options.__post_init__ calls this to validate ``fault_spec`` eagerly)."""
+    (Options.__post_init__ calls this to validate ``fault_spec`` eagerly).
+
+    ``extra_sites`` admits harness-level pseudo-sites beyond FAULT_SITES —
+    the chaos orchestrator serializes whole schedules (kills, restarts) in
+    this grammar so a shrunk repro is one copy-pasteable string."""
     rules = []
     for chunk in spec.split(";"):
         chunk = chunk.strip()
@@ -147,7 +200,7 @@ def parse_fault_spec(spec: str) -> tuple[FaultRule, ...]:
         head, _, tail = chunk.partition(":")
         site, sep, count = head.partition("@")
         site = site.strip()
-        if site not in FAULT_SITES:
+        if site not in FAULT_SITES and site not in extra_sites:
             raise ValueError(
                 f"unknown fault site {site!r} in {chunk!r}; "
                 f"expected one of {FAULT_SITES}"
@@ -165,6 +218,19 @@ def parse_fault_spec(spec: str) -> tuple[FaultRule, ...]:
                 params.append((key.strip(), _coerce(value.strip())))
         rules.append(FaultRule(site, int(count.strip()), tuple(params)))
     return tuple(rules)
+
+
+def format_fault_spec(rules) -> str:
+    """Inverse of :func:`parse_fault_spec`: render rules back to the spec
+    grammar (``parse(format(rules)) == tuple(rules)`` for coercible params).
+    The chaos shrinker emits minimal repros through this."""
+    chunks = []
+    for r in rules:
+        head = f"{r.site}@{r.at}"
+        if r.params:
+            head += ":" + ",".join(f"{k}={v}" for k, v in r.params)
+        chunks.append(head)
+    return ";".join(chunks)
 
 
 class FaultInjector:
@@ -208,6 +274,7 @@ class FaultInjector:
 _NULL = FaultInjector()
 _installed: FaultInjector | None = None
 _env_injector: FaultInjector | None = None
+_env_spec: str | None = None  # the SR_FAULT_SPEC value _env_injector was built from
 
 
 def install(spec: str | None) -> FaultInjector:
@@ -218,13 +285,48 @@ def install(spec: str | None) -> FaultInjector:
     return _installed if _installed is not None else active()
 
 
+def reset_env_injector() -> None:
+    """Drop the cached env injector so the next :func:`active` re-reads
+    ``SR_FAULT_SPEC`` and restarts its call counts (rig/test hook)."""
+    global _env_injector, _env_spec
+    _env_injector = None
+    _env_spec = None
+
+
 def active() -> FaultInjector:
-    """The process's active injector: the installed one, else one built
-    (once) from SR_FAULT_SPEC, else a null injector that never fires."""
-    global _env_injector
+    """The process's active injector: the installed one, else one built from
+    SR_FAULT_SPEC, else a null injector that never fires. The env injector
+    is rebuilt whenever the env var's VALUE differs from the one it was
+    built from — changing or unsetting SR_FAULT_SPEC mid-process takes
+    effect at the next call instead of being silently ignored (call counts
+    restart with the new spec; an unchanged spec keeps its counts)."""
+    global _env_injector, _env_spec
     if _installed is not None:
         return _installed
-    if _env_injector is None:
-        spec = os.environ.get("SR_FAULT_SPEC", "")
+    spec = os.environ.get("SR_FAULT_SPEC", "")
+    if _env_injector is None or spec != _env_spec:
+        _env_spec = spec
         _env_injector = FaultInjector(parse_fault_spec(spec)) if spec else _NULL
     return _env_injector
+
+
+def skewed_time(host: str | None = None) -> float:
+    """``time.time()`` plus any injected per-host clock skew. Pod heartbeat
+    stamps, suspect scans, and the serve stall watchdog read the wall clock
+    through this, so a ``clock_skew`` rule shifts ONE host's notion of
+    "now" while the rest of the pod stays honest. The skew latches: once
+    the rule's call count is reached the offset applies to every later
+    call (a skewed clock stays skewed until the injector is replaced)."""
+    import time
+
+    inj = active()
+    if inj.armed("clock_skew"):
+        hit = inj.fire("clock_skew")
+        if hit is not None:
+            want = hit.get("host")
+            if want is None or host is None or str(want) == str(host):
+                inj._skew_offset = float(hit.get("offset_s", 120.0))
+        off = getattr(inj, "_skew_offset", 0.0)
+        if off:
+            return time.time() + off
+    return time.time()

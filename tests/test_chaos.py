@@ -1,0 +1,284 @@
+"""Chaos harness (r19): seeded schedules, the ddmin shrinker, and the
+global invariant auditor.
+
+The full-stack soak itself lives in scripts/chaos_soak.py (CI runs it as
+its own gate step); these tests pin the harness MACHINERY — determinism,
+routing, minimization, and every auditor contract — at unit speed, plus
+the ``SR_CHAOS_BREAK`` demo hook that deliberately reverts the disk-full
+degradation so the auditor provably catches a regression.
+"""
+
+import os
+
+import pytest
+
+from symbolicregression_jl_tpu.utils import faults
+from symbolicregression_jl_tpu.utils.chaos import (
+    KILL_SITE,
+    ddmin,
+    generate_schedule,
+    host_env_spec,
+    kill_events,
+    parse_schedule,
+    schedule_spec,
+)
+from symbolicregression_jl_tpu.utils.faults import FaultRule
+from symbolicregression_jl_tpu.utils.invariants import InvariantAuditor
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    yield
+    faults.install(None)
+
+
+# -- schedule generation -------------------------------------------------------
+
+
+def test_same_seed_is_byte_identical():
+    for seed in range(8):
+        a = schedule_spec(generate_schedule(seed, 60.0))
+        b = schedule_spec(generate_schedule(seed, 60.0))
+        assert a == b and a  # non-empty and byte-equal
+
+
+def test_different_seeds_differ():
+    specs = {schedule_spec(generate_schedule(s, 60.0)) for s in range(8)}
+    assert len(specs) > 1
+
+
+def test_schedule_round_trips_through_spec_grammar():
+    sched = generate_schedule(3, 45.0, hosts=("h0", "h1", "h2"))
+    assert parse_schedule(schedule_spec(sched)) == sched
+
+
+def test_coverage_floor_every_seed():
+    # every seed composes a kill with all four r19 degradation sites
+    for seed in range(10):
+        sites = {r.site for r in generate_schedule(seed, 60.0)}
+        assert {KILL_SITE, "disk_full", "kv_partition", "clock_skew",
+                "oom_compile"} <= sites
+
+
+def test_host_env_spec_routes_and_strips_host():
+    sched = generate_schedule(0, 60.0)
+    for host in ("h0", "h1"):
+        spec = host_env_spec(sched, host)
+        if not spec:
+            continue
+        rules = faults.parse_fault_spec(spec)
+        assert all(r.site != KILL_SITE for r in rules)
+        assert all("host" not in dict(r.params) for r in rules)
+    # every non-kill rule lands in exactly one host's env
+    total = sum(
+        len(faults.parse_fault_spec(host_env_spec(sched, h)) if
+            host_env_spec(sched, h) else ())
+        for h in ("h0", "h1", "net")
+    )
+    assert total == sum(1 for r in sched if r.site != KILL_SITE)
+
+
+def test_kill_events_sorted_by_time():
+    sched = (
+        FaultRule(KILL_SITE, 0, (("at_s", 20.0), ("down_s", 2.0),
+                                 ("host", "h1"))),
+        FaultRule(KILL_SITE, 1, (("at_s", 5.0), ("down_s", 3.0),
+                                 ("host", "h0"))),
+    )
+    evs = kill_events(sched)
+    assert [e["host"] for e in evs] == ["h0", "h1"]
+    assert evs[0]["at_s"] == 5.0 and evs[1]["down_s"] == 2.0
+
+
+# -- shrinker ------------------------------------------------------------------
+
+
+def test_ddmin_finds_minimal_pair():
+    entries = tuple(FaultRule("stall", i, ()) for i in range(8))
+
+    def failing(subset):
+        return {2, 5} <= {r.at for r in subset}
+
+    assert {r.at for r in ddmin(entries, failing)} == {2, 5}
+
+
+def test_ddmin_single_culprit_and_result_is_one_minimal():
+    entries = tuple(FaultRule("stall", i, ()) for i in range(7))
+
+    def failing(subset):
+        return any(r.at == 4 for r in subset)
+
+    out = ddmin(entries, failing)
+    assert [r.at for r in out] == [4]
+    # 1-minimality in general: removing any entry of the result passes
+    for i in range(len(out)):
+        rest = out[:i] + out[i + 1:]
+        assert not rest or not failing(rest)
+
+
+def test_ddmin_nonreproducing_returns_input_unshrunk():
+    entries = tuple(FaultRule("stall", i, ()) for i in range(4))
+    assert ddmin(entries, lambda s: False) == entries
+
+
+# -- invariant auditor ---------------------------------------------------------
+
+
+def test_auditor_flags_lost_job_and_exempts_shed():
+    a = InvariantAuditor()
+    a.note_submit("pj-kept", niterations=2)
+    a.note_submit("pj-shed")
+    a.note_submit("pj-lost")
+    a.note_shed("pj-shed")
+    a.observe_done("pj-kept", {"state": "done"})
+    a.finalize()
+    assert a.breach_names() == {"no_lost_jobs"}
+    assert any("pj-lost" in b.detail for b in a.breaches)
+    assert not any("pj-shed" in b.detail for b in a.breaches)
+
+
+def test_auditor_flags_duplicates_once():
+    a = InvariantAuditor()
+    a.observe_host_stats("h0", {"duplicate_results": 0})
+    assert a.ok
+    a.observe_host_stats("h0", {"duplicate_results": 2})
+    a.observe_host_stats("h0", {"duplicate_results": 2})  # same count: no spam
+    assert [b.invariant for b in a.breaches] == ["exactly_once"]
+
+
+def test_auditor_queue_and_buffer_bounds():
+    a = InvariantAuditor(queue_max_depth=4, journal_buffer_max=10)
+    a.observe_host_stats("h0", {"queue_depth": 4})
+    assert a.ok
+    a.observe_host_stats("h0", {"queue_depth": 5})
+    a.observe_host_stats(
+        "h1", {"server": {"queued": 1, "journal": {"buffered_records": 11}}}
+    )
+    assert sorted(b.invariant for b in a.breaches) == ["bounded", "bounded"]
+
+
+def test_auditor_stream_contract():
+    a = InvariantAuditor()
+    a.check_stream("s", dup_dropped=0, next_index=3,
+                   stored=[b"a", b"b", b"c"], tail=[b"a", b"b", b"c"])
+    assert a.ok
+    a.check_stream("s", dup_dropped=1, next_index=2,
+                   stored=[b"a", b"b", b"c"], tail=[b"a", b"b"])
+    assert a.breach_names() == {"frame_monotonic"}
+    assert len(a.breaches) == 2  # duplicate delivery AND cursor mismatch
+
+
+def test_auditor_stream_tail_divergence():
+    a = InvariantAuditor()
+    a.check_stream("s", dup_dropped=0, next_index=2,
+                   stored=[b"a", b"b"], tail=[b"a", b"X"])
+    assert a.breach_names() == {"frame_monotonic"}
+
+
+def test_auditor_frame_index_gap():
+    a = InvariantAuditor()
+    a.observe_stream_frame("s", 0)
+    a.observe_stream_frame("s", 1)
+    a.observe_stream_frame("s", 3)
+    assert a.breach_names() == {"frame_monotonic"}
+
+
+def test_auditor_resume_budget():
+    a = InvariantAuditor()
+    a.note_submit("pj", niterations=10)
+    a.observe_done("pj", {
+        "state": "done", "resumed_from_iteration": 4,
+        "iterations_done": 7, "stop_reason": None,
+    })
+    assert a.breach_names() == {"resume_exact"}
+    # early stop is exempt
+    b = InvariantAuditor()
+    b.note_submit("pj", niterations=10)
+    b.observe_done("pj", {
+        "state": "done", "resumed_from_iteration": 4,
+        "iterations_done": 7, "stop_reason": "timeout",
+    })
+    assert b.ok
+
+
+def test_auditor_journal_check_real_journal(tmp_path):
+    from symbolicregression_jl_tpu.serve.journal import JOURNAL_MAGIC, JobJournal
+
+    jdir = str(tmp_path / "j")
+    j = JobJournal(jdir, fsync=False)
+    j.append("submit", "job-1", seq=1, spec=None, kind="search",
+             submitted_at=0.0)
+    j.append("start", "job-1", attempt=1)
+    j.close()
+    # torn tail: half a frame appended after the good records
+    path = os.path.join(jdir, "journal.log")
+    with open(path, "ab") as f:
+        f.write(b"\x40\x00\x00\x00garbage")
+    a = InvariantAuditor()
+    a.check_journal(jdir, context="test")
+    assert a.ok, a.report()
+    # a corrupted magic resets the log to fresh — graceful, not a breach
+    with open(path, "r+b") as f:
+        f.write(b"X" * len(JOURNAL_MAGIC))
+    a2 = InvariantAuditor()
+    a2.check_journal(jdir, context="test")
+    assert a2.ok, a2.report()
+
+
+def test_auditor_journal_breach_when_replay_raises(tmp_path, monkeypatch):
+    # replay raising (a regression in the truncation discipline) must be
+    # reported, not propagated
+    from symbolicregression_jl_tpu.serve import journal as jmod
+
+    class _Boom:
+        def __init__(self, *a, **k):
+            raise RuntimeError("replay exploded")
+
+    monkeypatch.setattr(jmod, "JobJournal", _Boom)
+    a = InvariantAuditor()
+    a.check_journal(str(tmp_path), context="test")
+    assert a.breach_names() == {"journal_replayable"}
+
+
+# -- deliberate-regression demo hook -------------------------------------------
+
+
+def test_chaos_break_hook_drops_shed_submit(tmp_path, monkeypatch):
+    """SR_CHAOS_BREAK=shed_silently reverts the disk-full shed to a silent
+    drop: submit() hands back a job id for a job that no longer exists —
+    exactly the regression the soak's no_lost_jobs invariant must catch."""
+    import numpy as np
+
+    from symbolicregression_jl_tpu import Options
+    from symbolicregression_jl_tpu.serve import (
+        JobSpec,
+        SearchServer,
+        ServerOverloaded,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2, 32)).astype(np.float32)
+    y = X[0].astype(np.float32)
+    opts = Options(
+        binary_operators=["+", "*"], populations=2, population_size=8,
+        ncycles_per_iteration=4, maxsize=8, seed=0, scheduler="lockstep",
+        save_to_file=False,
+    )
+    faults.install("disk_full@0:path=journal,clear=1")
+    with SearchServer(
+        max_concurrency=1, journal_dir=str(tmp_path / "j")
+    ) as srv:
+        # honest path: the shed refuses the submit
+        with pytest.raises(ServerOverloaded):
+            srv.submit(JobSpec(X, y, options=opts, niterations=1))
+        # broken path: same fault, silent drop
+        faults.install("disk_full@0:path=journal,clear=1")
+        monkeypatch.setenv("SR_CHAOS_BREAK", "shed_silently")
+        jid = srv.submit(JobSpec(X, y, options=opts, niterations=1))
+        assert jid
+        with pytest.raises(KeyError):
+            srv.job(jid)  # the job vanished: a client-visible lost job
+        a = InvariantAuditor()
+        a.note_submit(jid)
+        a.finalize()
+        assert a.breach_names() == {"no_lost_jobs"}

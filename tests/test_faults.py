@@ -86,12 +86,68 @@ def test_parse_fault_spec_elastic_sites():
     Options(fault_spec="slow_peer@0:delay_ms=10")
 
 
+def test_parse_fault_spec_r19_sites_and_format_round_trip():
+    """The r19 resource-exhaustion sites parse, format, and re-parse."""
+    spec = (
+        "disk_full@2:clear=1,path=journal;oom_compile@0:kind=fleet_aot;"
+        "clock_skew@3:host=h1,offset_s=120;kv_partition@5:block=h0,ops=40"
+    )
+    rules = faults.parse_fault_spec(spec)
+    for site in ("disk_full", "oom_compile", "clock_skew", "kv_partition"):
+        assert site in faults.FAULT_SITES
+    assert faults.format_fault_spec(rules) == spec
+    assert faults.parse_fault_spec(faults.format_fault_spec(rules)) == rules
+    Options(fault_spec="disk_full@0:path=ckpt")
+
+
+def test_parse_fault_spec_extra_sites_admits_pseudo_sites():
+    rules = faults.parse_fault_spec(
+        "kill@0:at_s=12.5,host=h0", extra_sites=("kill",)
+    )
+    assert rules[0].site == "kill"
+    assert dict(rules[0].params) == {"at_s": 12.5, "host": "h0"}
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec("kill@0:host=h0")  # not a real site
+
+
 @pytest.mark.parametrize(
     "bad", ["gremlin@1", "nan_flood", "nan_flood@x", "nan_flood@1:frac"]
 )
 def test_parse_fault_spec_rejects_malformed(bad):
     with pytest.raises(ValueError):
         faults.parse_fault_spec(bad)
+
+
+def test_env_injector_tracks_spec_changes(monkeypatch):
+    """Regression (r19): the env injector cached the FIRST SR_FAULT_SPEC it
+    saw for the process lifetime — a respawned-in-process rig (or a test
+    changing the env) kept firing stale rules."""
+    faults.install(None)
+    monkeypatch.setenv("SR_FAULT_SPEC", "stall@0")
+    assert faults.active().armed("stall")
+    monkeypatch.setenv("SR_FAULT_SPEC", "nan_flood@1:frac=0.5")
+    inj = faults.active()
+    assert inj.armed("nan_flood") and not inj.armed("stall")
+    assert inj is faults.active()  # unchanged spec: same injector (counts live)
+    monkeypatch.delenv("SR_FAULT_SPEC")
+    assert not faults.active().armed("nan_flood")
+    faults.reset_env_injector()
+
+
+def test_skewed_time_latches_offset_per_host(monkeypatch):
+    faults.install("clock_skew@1:host=h0,offset_s=500")
+    import time as _time
+
+    t0 = _time.time()
+    assert abs(faults.skewed_time("h0") - t0) < 5.0  # count 0: no fire yet
+    t1 = faults.skewed_time("h0")  # count 1: fires and latches
+    assert t1 - _time.time() > 400.0
+    t2 = faults.skewed_time("h0")  # latched: stays skewed
+    assert t2 - _time.time() > 400.0
+    # a different host never skews
+    faults.install("clock_skew@0:host=h0,offset_s=500")
+    assert abs(faults.skewed_time("h1") - _time.time()) < 5.0
+    assert abs(faults.skewed_time("h1") - _time.time()) < 5.0
 
 
 def test_options_validate_fault_spec_and_on_peer_loss(tmp_path):
@@ -198,6 +254,30 @@ def test_ckpt_crash_leaves_previous_snapshot_loadable(tmp_path):
         resume_from=ck_base,
     )
     assert np.isfinite(min(m.loss for m in resumed.pareto_frontier))
+
+
+def test_checkpoint_enospc_keeps_previous_snapshot_and_run_alive(tmp_path):
+    """Disk-full during a snapshot (r19 ``disk_full`` site, ``path=ckpt``):
+    the write is skipped, the PREVIOUS snapshot stays loadable, no torn tmp
+    file survives, and the search completes instead of crashing."""
+    X, y = _problem()
+    opts = _opts(
+        tmp_path, checkpoint_every=1, fault_spec="disk_full@3:path=ckpt"
+    )
+    res = equation_search(X, y, options=opts, niterations=4, verbosity=0)
+    assert np.isfinite(min(m.loss for m in res.pareto_frontier))
+    # the 4th save (count 3) hit ENOSPC: the iteration-3 snapshot survives
+    ck = load_checkpoint(str(tmp_path / "ck.pkl"))
+    assert ck.iteration == 3
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    # journal-only rules must NOT touch checkpoints
+    opts2 = _opts(
+        tmp_path / "b", checkpoint_every=1,
+        fault_spec="disk_full@0:path=journal",
+    )
+    (tmp_path / "b").mkdir()
+    equation_search(X, y, options=opts2, niterations=2, verbosity=0)
+    assert load_checkpoint(str(tmp_path / "b" / "ck.pkl")).iteration == 2
 
 
 def test_checkpoint_retention_prunes_old_snapshots(tmp_path):

@@ -496,3 +496,34 @@ def test_server_dedups_identical_jobs():
             assert len(srv.frames(j.id)) > 0
     finally:
         srv.shutdown()
+
+
+def test_fleet_oom_compile_downshifts_and_completes():
+    """An injected RESOURCE_EXHAUSTED at the fleet AOT build (oom_compile
+    site) must halve the batch and finish every lane on the smaller
+    programs — no failed jobs, no quarantine, no retry-budget burn, and
+    the downshift is visible in stats()."""
+    from symbolicregression_jl_tpu.models.device_search import PROGRAM_CACHE
+    from symbolicregression_jl_tpu.serve import DONE, JobSpec, SearchServer
+    from symbolicregression_jl_tpu.utils import faults
+
+    X, y = _problem()
+    PROGRAM_CACHE.evict("fleet_aot")  # force a real compile-kind miss
+    faults.install("oom_compile@0:kind=fleet_aot")
+    srv = SearchServer(
+        max_concurrency=1, fleet=True, fleet_max=2, fleet_window_s=2.0
+    ).start()
+    try:
+        ids = [
+            srv.submit(JobSpec(X=X, y=y, options=_opts(seed=s), niterations=1))
+            for s in (0, 11)
+        ]
+        jobs = [srv.wait(i, timeout=900) for i in ids]
+        assert all(j.state == DONE for j in jobs), [j.summary() for j in jobs]
+        assert all(j.attempts == 1 for j in jobs)  # downshift is free
+        s = srv.stats()
+        assert s["oom_downshifts"] >= 1, s
+        assert s["quarantined"] == 0
+    finally:
+        srv.shutdown()
+        faults.install(None)

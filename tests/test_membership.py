@@ -557,3 +557,60 @@ def test_should_use_group_and_elastic_enabled(tmp_path, monkeypatch):
     monkeypatch.setenv("SR_ELASTIC_ID", "1")
     assert dist.world_shape() == (4, 1)
     assert mem.should_use_group(opt2)
+
+
+# -- kv_partition fault site (r19) --------------------------------------------
+
+
+def test_partitioned_store_severs_then_heals(tmp_path):
+    """The kv_partition wrapper: blocked-host keys vanish from THIS
+    process's view (reads None/Timeout, writes dropped, CAS loses, list
+    filters) for exactly ``ops`` store operations, then heal — and the
+    inner store proves no severed write ever leaked through."""
+    inner = _store(tmp_path)
+    inner.set("srpod/p/ad/h0", b"A")
+    inner.set("srpod/p/ad/h1", b"B")
+    store = mem.PartitionedCoordStore(inner)
+    faults.install("kv_partition@0:block=h0,ops=6")
+    # op 1 fires the rule and is the first severed-capable operation
+    assert store.try_get("srpod/p/ad/h0") is None
+    assert store.try_get("srpod/p/ad/h1") == b"B"  # far side unaffected
+    with pytest.raises(TimeoutError):
+        store.get("srpod/p/ad/h0", timeout_ms=10)
+    assert store.set_if_absent("srpod/p/claim/h0/1", b"me") is False
+    assert inner.try_get("srpod/p/claim/h0/1") is None  # CAS never wrote
+    store.set("srpod/p/ad/h0", b"dropped")  # write silently dropped
+    st = store.partition_stats()
+    assert st["active"] and st["partitions"] == 1 and st["dropped_ops"] >= 4
+    # 6th op heals: full connectivity returns, nothing was forged
+    assert store.list("srpod/p/ad/") == ["srpod/p/ad/h0", "srpod/p/ad/h1"]
+    assert store.try_get("srpod/p/ad/h0") == b"A"  # original value intact
+    assert store.set_if_absent("srpod/p/claim/h0/1", b"me") is True
+    st = store.partition_stats()
+    assert not st["active"] and st["healed"] == 1
+
+
+def test_partitioned_store_list_filters_blocked_keys(tmp_path):
+    inner = _store(tmp_path)
+    inner.set("srpod/p/inbox/h0/pj-1", b"x")
+    inner.set("srpod/p/inbox/h1/pj-2", b"y")
+    store = mem.PartitionedCoordStore(inner)
+    faults.install("kv_partition@0:block=h1,ops=50")
+    store.try_get("srpod/p/ad/h0")  # fires the rule
+    assert store.list("srpod/p/inbox/") == ["srpod/p/inbox/h0/pj-1"]
+    # a prefix that ITSELF names the blocked host is fully unreachable
+    assert store.list("srpod/p/inbox/h1/") == []
+
+
+def test_coord_store_wraps_when_kv_partition_armed(tmp_path, monkeypatch):
+    """coord_store() must hand every consumer the partition view when the
+    site is armed — and rig plumbing that needs the file root must keep
+    working through the wrapper (PodNode unwraps ``.inner``)."""
+    monkeypatch.setenv("SR_COORD_DIR", str(tmp_path / "c"))
+    faults.install("kv_partition@9:block=h1,ops=5")
+    store = mem.coord_store()
+    assert isinstance(store, mem.PartitionedCoordStore)
+    assert isinstance(store.inner, mem.FileCoordStore)
+    assert store.root == store.inner.root  # attribute passthrough
+    faults.install(None)
+    assert isinstance(mem.coord_store(), mem.FileCoordStore)  # unwrapped

@@ -286,3 +286,47 @@ def test_drain_hands_off_queued_jobs_to_survivor(tmp_path):
         assert set(c.results()) == set(pjids)
     finally:
         h1.stop()
+
+
+# -- clock-skew adoption discipline (r19) -------------------------------------
+
+
+def test_skewed_observer_never_adopts_live_host(tmp_path, monkeypatch):
+    """An observer whose wall clock is +600s sees every peer ad as ancient.
+    It must NOT claim a live, heartbeating host's generation (the r19 soak
+    caught exactly that: one publish-jitter beat straddling two scans used
+    to defeat the progress veto) — yet a genuinely dead host, whose stamp
+    stays frozen for a full suspect window, is still adopted under skew."""
+    from symbolicregression_jl_tpu.utils import faults as faults_mod
+
+    real_time = time.time
+
+    def fake_skewed(host=None):
+        return real_time() + (600.0 if host == "h0" else 0.0)
+
+    monkeypatch.setattr(faults_mod, "skewed_time", fake_skewed)
+    store = _store(tmp_path)
+    h0 = _node(store, "h0").start()
+    h1 = _node(store, "h1").start()
+    try:
+        # 4+ suspect windows of coexistence: h0 sees h1 as 600s stale the
+        # whole time, and must keep suppressing instead of claiming
+        time.sleep(2.5)
+        assert store.try_get(h0.keys.claim("h1", 1)) is None
+        assert h0.stats()["skew_suspects_suppressed"] > 0
+        assert h1.stats()["adopted_hosts"] == 0
+        # now h1 actually dies: its ad stamp freezes, and the skewed
+        # observer must still take over once the freeze outlives a full
+        # local-monotonic suspect window
+        h1.stop()
+        deadline = time.time() + 30
+        while store.try_get(h0.keys.claim("h1", 1)) is None:
+            assert time.time() < deadline, "skewed observer never adopted " \
+                "the genuinely dead host"
+            time.sleep(0.05)
+    finally:
+        h0.stop()
+        try:
+            h1.stop()
+        except Exception:  # noqa: BLE001 — already stopped
+            pass

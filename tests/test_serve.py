@@ -219,3 +219,42 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
         assert last.iteration == 4 and last.niterations == 4
         # the high-priority job ran before the low job's resumed tail
         assert hj.finished_at <= lj.finished_at
+
+
+# -- r19 degradation counters and clock-skew watchdog -------------------------
+
+
+def test_stats_expose_degradation_counters(tmp_path):
+    """Satellite contract: every graceful-degradation path is observable
+    from stats() so the chaos auditor (and dashboards) can watch them."""
+    with SearchServer(
+        max_concurrency=1, journal_dir=str(tmp_path / "j")
+    ) as srv:
+        s = srv.stats()
+        assert s["journal_read_only"] is False
+        assert s["journal_shed"] == 0
+        assert s["oom_downshifts"] == 0
+        assert s["skew_suspects_suppressed"] == 0
+        assert s["journal"]["shed_submits"] == 0
+
+
+def test_clock_skew_suppresses_stall_watchdog(tmp_path):
+    """An injected +600s wall-clock jump makes every running heartbeat look
+    ancient; the watchdog's monotonic cross-check must absorb the jump
+    (skew_suspects_suppressed) instead of stall-killing a healthy run."""
+    from symbolicregression_jl_tpu.utils import faults
+
+    X, y = _problem()
+    faults.install("clock_skew@3:offset_s=600")
+    srv = SearchServer(
+        max_concurrency=1, stall_seconds=1.5, poll_seconds=0.05
+    ).start()
+    try:
+        jid = srv.submit(_spec(X, y, niterations=3))
+        job = srv.wait(jid, timeout=900)
+        assert job.state == DONE, job.summary()
+        assert job.attempts == 1  # never stall-stopped and retried
+        assert srv.stats()["skew_suspects_suppressed"] >= 1
+    finally:
+        srv.shutdown()
+        faults.install(None)

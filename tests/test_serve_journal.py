@@ -172,6 +172,151 @@ def test_journal_torn_write_fault_site(tmp_path):
     assert JobJournal(d).stats()["path"].endswith("journal.log")
 
 
+def test_journal_rotation_races_concurrent_appends(tmp_path):
+    """r19 satellite: rotation (forced by a tiny max_bytes AND called
+    explicitly from a racing thread) must never drop a record appended
+    concurrently — both serialize on the journal lock, so the merged state
+    after replay accounts for every job."""
+    import threading
+
+    d = str(tmp_path / "jr")
+    jr = JobJournal(d, fsync=False, max_bytes=2048)
+    n_threads, n_jobs = 4, 12
+    stop = threading.Event()
+
+    def submitter(t):
+        for i in range(n_jobs):
+            jid = f"t{t}-j{i}"
+            jr.append("submit", jid, seq=t * 100 + i, submitted_at=float(i),
+                      spec=b"S", kind="search")
+            jr.append("progress", jid, fsync=False, iterations_done=1)
+            jr.append("terminal", jid, state="done", error=None)
+
+    def rotator():
+        while not stop.is_set():
+            jr.rotate()
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(n_threads)]
+    rot = threading.Thread(target=rotator)
+    rot.start()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    stop.set()
+    rot.join()
+    assert jr.stats()["rotations"] > 0
+    jr.close()
+    st = JobJournal(d).replay()
+    want = {f"t{t}-j{i}" for t in range(n_threads) for i in range(n_jobs)}
+    assert set(st) == want  # zero lost, zero invented
+    assert all(s["state"] == "done" for s in st.values())
+
+
+# -- disk-full degradation (r19) -----------------------------------------------
+
+
+def test_journal_disk_full_sheds_submit_then_rearms(tmp_path):
+    """The full ENOSPC protocol: emergency compaction + retry, running-job
+    records buffered while read-only, submits refused with JournalDiskFull,
+    and the first successful append re-arms and drains the buffer in order."""
+    from symbolicregression_jl_tpu.serve.journal import JournalDiskFull
+
+    d = str(tmp_path / "jr")
+    jr = JobJournal(d, fsync=False)
+    jr.append("submit", "j1", seq=1, submitted_at=1.0, spec=b"S",
+              kind="search")
+    # clear=2: the firing append, the post-compaction retry, and one more
+    # all see a full disk — the journal stays read-only across the window
+    faults.install("disk_full@0:path=journal,clear=2")
+    jr.append("progress", "j1", fsync=False, iterations_done=5)  # buffered
+    s = jr.stats()
+    assert s["read_only"] and s["buffered_records"] == 1
+    assert s["enospc_events"] == 1 and s["emergency_compactions"] == 1
+    with pytest.raises(JournalDiskFull):
+        jr.append("submit", "j2", seq=2, submitted_at=2.0, spec=b"S",
+                  kind="search")
+    assert jr.stats()["shed_submits"] == 1
+    # space returns: this append is the probe — it re-arms and drains the
+    # buffered progress record FIRST so replay order matches append order
+    jr.append("progress", "j1", fsync=False, iterations_done=9)
+    s = jr.stats()
+    assert not s["read_only"] and s["buffered_records"] == 0
+    assert s["rearms"] == 1
+    faults.install(None)
+    jr.close()
+    st = JobJournal(d).replay()
+    assert set(st) == {"j1"}  # the shed submit is NOT in the journal
+    assert st["j1"]["iterations_done"] == 9
+
+
+def test_journal_enospc_partial_write_never_poisons_the_tail(tmp_path):
+    """A REAL ENOSPC can cut a frame mid-write; the pre-write-offset
+    truncation must remove the partial frame so later appends replay
+    cleanly instead of being lost to torn-tail truncation."""
+    import errno as _e
+
+    d = str(tmp_path / "jr")
+    jr = JobJournal(d, fsync=False)
+    jr.append("submit", "j1", seq=1, submitted_at=1.0, spec=b"S",
+              kind="search")
+
+    class _HalfThenFail:
+        def __init__(self, fh):
+            self.fh = fh
+            self.fail_next = False
+
+        def write(self, b):
+            if self.fail_next:
+                self.fail_next = False
+                self.fh.write(b[: max(1, len(b) // 2)])
+                raise OSError(_e.ENOSPC, "No space left on device")
+            return self.fh.write(b)
+
+        def __getattr__(self, name):
+            return getattr(self.fh, name)
+
+    wrapped = _HalfThenFail(jr._fh)
+    jr._fh = wrapped
+    wrapped.fail_next = True
+    # the first write tears mid-frame; the pre-write offset is truncated
+    # back, the emergency-compaction retry succeeds, and the record lands
+    jr.append("progress", "j1", fsync=False, iterations_done=3)
+    s = jr.stats()
+    assert s["enospc_events"] == 1 and not s["read_only"]
+    jr.append("terminal", "j1", state="done", error=None)
+    jr.close()
+    st1 = JobJournal(d).replay()
+    st2 = JobJournal(d).replay()
+    assert st1 == st2  # no torn tail left behind
+    assert st1["j1"]["state"] == "done"
+    assert st1["j1"]["iterations_done"] == 3  # the buffered record survived
+
+
+def test_server_submit_shed_on_disk_full_then_accepts(tmp_path):
+    """SearchServer maps JournalDiskFull to ServerOverloaded (client
+    retries later) and exposes the degradation in stats(); once space
+    returns the SAME submit succeeds."""
+    X, y = _problem()
+    faults.install("disk_full@0:path=journal,clear=1")
+    with SearchServer(
+        max_concurrency=1, journal_dir=str(tmp_path / "j")
+    ) as srv:
+        with pytest.raises(ServerOverloaded):
+            srv.submit(_spec(X, y, niterations=1))
+        s = srv.stats()
+        assert s["journal_shed"] == 1
+        assert s["journal_read_only"] is True
+        # space back: the resubmit is accepted and runs to DONE
+        jid = srv.submit(_spec(X, y, niterations=1))
+        assert srv.wait(jid, timeout=600).state == DONE
+        s = srv.stats()
+        assert s["journal_read_only"] is False
+        assert s["journal"]["rearms"] == 1
+    faults.install(None)
+
+
 # -- crash recovery ------------------------------------------------------------
 
 
